@@ -1,0 +1,10 @@
+# CPU test invocation: PYTHONPATH bypasses the axon sitecustomize (which can
+# hang interpreter startup when the TPU tunnel is down) and puts the package
+# on the path without an installed wheel.
+PY := env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	python bench.py

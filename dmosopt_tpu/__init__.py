@@ -1,0 +1,21 @@
+"""dmosopt-tpu: TPU-native multi-objective adaptive surrogate-model optimization.
+
+A from-scratch JAX/XLA re-design of the capabilities of dmosopt
+(reference: /root/reference): MO-ASMO epoch loop, evolutionary optimizers
+(NSGA-II, AGE-MOEA, MO-CMA-ES, SMPSO, TRS), GP surrogates, hypervolume
+stack, sampling/DoE, feasibility/sensitivity, termination, HDF5
+checkpoint/resume — with populations as sharded device arrays and all hot
+loops jitted.
+"""
+
+__version__ = "0.1.0"
+
+from dmosopt_tpu.datatypes import (  # noqa: F401
+    EpochResults,
+    EvalEntry,
+    EvalRequest,
+    OptHistory,
+    OptProblem,
+    ParameterSpace,
+    StrategyState,
+)

@@ -1,0 +1,73 @@
+"""Zitzler-Deb-Thiele benchmark problems, batched and jittable.
+
+Analytic definitions match the reference's example/test objective functions
+(reference: tests/test_zdt1_nsga2_trs.py:10-21, examples/example_dmosopt_zdt*.py),
+but evaluate whole populations at once: ``f(X) -> Y`` with X (B, n), Y (B, 2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def zdt1(x: jax.Array) -> jax.Array:
+    x = jnp.atleast_2d(x)
+    n = x.shape[1]
+    f1 = x[:, 0]
+    g = 1.0 + 9.0 / (n - 1) * jnp.sum(x[:, 1:], axis=1)
+    h = 1.0 - jnp.sqrt(f1 / g)
+    return jnp.stack([f1, g * h], axis=1)
+
+
+@jax.jit
+def zdt2(x: jax.Array) -> jax.Array:
+    x = jnp.atleast_2d(x)
+    n = x.shape[1]
+    f1 = x[:, 0]
+    g = 1.0 + 9.0 / (n - 1) * jnp.sum(x[:, 1:], axis=1)
+    h = 1.0 - (f1 / g) ** 2
+    return jnp.stack([f1, g * h], axis=1)
+
+
+@jax.jit
+def zdt3(x: jax.Array) -> jax.Array:
+    x = jnp.atleast_2d(x)
+    n = x.shape[1]
+    f1 = x[:, 0]
+    g = 1.0 + 9.0 / (n - 1) * jnp.sum(x[:, 1:], axis=1)
+    h = 1.0 - jnp.sqrt(f1 / g) - (f1 / g) * jnp.sin(10.0 * jnp.pi * f1)
+    return jnp.stack([f1, g * h], axis=1)
+
+
+def zdt1_pareto(n_points: int = 100) -> np.ndarray:
+    f1 = np.linspace(0, 1, n_points)
+    return np.stack([f1, 1.0 - np.sqrt(f1)], axis=1)
+
+
+def zdt2_pareto(n_points: int = 100) -> np.ndarray:
+    f1 = np.linspace(0, 1, n_points)
+    return np.stack([f1, 1.0 - f1**2], axis=1)
+
+
+def zdt3_pareto(n_points: int = 100) -> np.ndarray:
+    # disconnected front: keep only non-dominated part of the g=1 curve
+    f1 = np.linspace(0, 1, n_points * 10)
+    f2 = 1.0 - np.sqrt(f1) - f1 * np.sin(10.0 * np.pi * f1)
+    pts = np.stack([f1, f2], axis=1)
+    keep = np.ones(len(pts), dtype=bool)
+    for i in range(len(pts)):
+        if keep[i]:
+            dominated = (pts[:, 0] <= pts[i, 0]) & (pts[:, 1] <= pts[i, 1])
+            dominated &= (pts[:, 0] < pts[i, 0]) | (pts[:, 1] < pts[i, 1])
+            if dominated.any():
+                keep[i] = False
+    return pts[keep][:: max(1, len(pts[keep]) // n_points)]
+
+
+def distance_to_front(Y, front: np.ndarray) -> np.ndarray:
+    """Per-point euclidean distance to a sampled analytic Pareto front
+    (oracle from reference tests/test_zdt1_nsga2_trs.py:39-72)."""
+    Y = np.asarray(Y)
+    d = np.sqrt(((Y[:, None, :] - front[None, :, :]) ** 2).sum(-1))
+    return d.min(axis=1)

@@ -1,0 +1,69 @@
+"""Registry of shorthand names -> import paths, and string-path imports.
+
+Mirrors the reference's extension mechanism (reference: dmosopt/config.py:5-48):
+every pluggable component (sampler, optimizer, surrogate, sensitivity,
+feasibility) is addressed either by a shorthand in a registry or by a full
+``module.path.Object`` import string.
+"""
+
+import importlib
+import sys
+
+
+def import_object_by_path(path: str):
+    module_path, _, obj_name = path.rpartition(".")
+    if module_path in ("__main__", ""):
+        module = sys.modules["__main__"]
+    else:
+        module = importlib.import_module(module_path)
+    return getattr(module, obj_name)
+
+
+default_sampling_methods = {
+    "glp": "dmosopt_tpu.sampling.glp",
+    "slh": "dmosopt_tpu.sampling.slh",
+    "lh": "dmosopt_tpu.sampling.lh",
+    "mc": "dmosopt_tpu.sampling.mc",
+    "sobol": "dmosopt_tpu.sampling.sobol",
+}
+
+default_optimizers = {
+    "nsga2": "dmosopt_tpu.optimizers.nsga2.NSGA2",
+    "age": "dmosopt_tpu.optimizers.agemoea.AGEMOEA",
+    "smpso": "dmosopt_tpu.optimizers.smpso.SMPSO",
+    "cmaes": "dmosopt_tpu.optimizers.cmaes.CMAES",
+    "trs": "dmosopt_tpu.optimizers.trs.TRS",
+}
+
+default_surrogate_methods = {
+    "gpr": "dmosopt_tpu.models.gp.GPR_Matern",
+    "egp": "dmosopt_tpu.models.gp.EGP_Matern",
+    "megp": "dmosopt_tpu.models.gp.MEGP_Matern",
+    "vgp": "dmosopt_tpu.models.svgp.VGP_Matern",
+    "svgp": "dmosopt_tpu.models.svgp.SVGP_Matern",
+    "spv": "dmosopt_tpu.models.svgp.SPV_Matern",
+    "siv": "dmosopt_tpu.models.svgp.SIV_Matern",
+    "crv": "dmosopt_tpu.models.svgp.CRV_Matern",
+}
+
+default_sa_methods = {
+    "dgsm": "dmosopt_tpu.sa.SA_DGSM",
+    "fast": "dmosopt_tpu.sa.SA_FAST",
+}
+
+default_feasibility_methods = {
+    "logreg": "dmosopt_tpu.feasibility.LogisticFeasibilityModel"
+}
+
+
+def resolve(name_or_path, registry):
+    """Resolve a shorthand or import path to an object; pass through callables."""
+    if callable(name_or_path):
+        return name_or_path
+    path = registry.get(name_or_path, name_or_path)
+    try:
+        return import_object_by_path(path)
+    except (ImportError, AttributeError) as e:
+        raise NotImplementedError(
+            f"component {name_or_path!r} (-> {path!r}) is not available: {e}"
+        ) from e

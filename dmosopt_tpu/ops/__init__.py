@@ -1,0 +1,24 @@
+from dmosopt_tpu.ops.dominance import (  # noqa: F401
+    comparison_matrix,
+    dominance_degree_matrix,
+    dominance_matrix,
+    non_dominated_rank,
+)
+from dmosopt_tpu.ops.distances import (  # noqa: F401
+    crowding_distance,
+    duplicate_mask,
+    euclidean_distance_metric,
+    pairwise_distances,
+)
+from dmosopt_tpu.ops.sort import (  # noqa: F401
+    order_mo,
+    remove_worst,
+    sort_mo,
+    top_k_mo,
+)
+from dmosopt_tpu.ops.variation import (  # noqa: F401
+    polynomial_mutation,
+    sbx_crossover,
+    tournament_probabilities,
+    tournament_selection,
+)

@@ -1,0 +1,91 @@
+"""Non-dominated sorting via the Dominance Degree Matrix, as one XLA kernel.
+
+The reference implements Zhou et al. 2017 with per-objective argsort loops and
+sequential front insertion (reference: dmosopt/dda.py:13-152). The key
+observation for a TPU: the per-objective comparison matrix constructed there
+is exactly ``C[a, b] = (y[a] <= y[b])`` (ties give 1 in both directions), so
+the full dominance degree matrix is a single broadcast-compare-reduce over an
+``(N, N, d)`` tensor — no sorting, no Python loops. Front assignment peels
+ranks with a ``lax.while_loop`` (one iteration per front, not per point).
+
+All functions are shape-static and mask-aware so populations can live in
+fixed-capacity arrays (masked slots get rank ``n``).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def comparison_matrix(y: jax.Array) -> jax.Array:
+    """Per-objective comparison matrix: ``C[a, b] = 1 iff y[a] <= y[b]``.
+
+    Matches the argsort-based construction of reference dmosopt/dda.py:13-34
+    (ties yield 1 in both directions).
+    """
+    return (y[:, None] <= y[None, :]).astype(jnp.int32)
+
+
+def dominance_degree_matrix(Y: jax.Array) -> jax.Array:
+    """``D[i, j]`` = number of objectives on which ``Y[i] <= Y[j]``.
+
+    Reference: dmosopt/dda.py:37-47, computed here as one reduction.
+    """
+    return (Y[:, None, :] <= Y[None, :, :]).sum(axis=-1).astype(jnp.int32)
+
+
+@jax.jit
+def non_dominated_rank(Y: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """Rank points into non-dominated fronts (0 = best).
+
+    Semantics match reference dmosopt/dda.py:50-133 (``dda_ns`` /
+    ``dda_ens`` produce the same ranking): build the dominance degree
+    matrix, zero out ties (identical objective vectors do not dominate each
+    other), then peel fronts.
+
+    Y: (n, d) objective matrix (minimization).
+    mask: optional (n,) bool; invalid rows get rank ``n`` and never dominate.
+    Returns (n,) int32 ranks.
+    """
+    n, d = Y.shape
+    D = dominance_degree_matrix(Y)
+    # Identical vectors: D[i,j] == D[j,i] == d -> neither dominates
+    # (reference dmosopt/dda.py:109-115).
+    tie = (D == d) & (D.T == d)
+    D = jnp.where(tie, 0, D)
+    dom = D == d  # dom[i, j]: i dominates j (strictly on >=1 objective)
+
+    if mask is not None:
+        valid = mask.astype(bool)
+        dom = dom & valid[:, None] & valid[None, :]
+    else:
+        valid = jnp.ones((n,), dtype=bool)
+
+    def cond(carry):
+        rank, alive, k = carry
+        return jnp.any(alive)
+
+    def body(carry):
+        rank, alive, k = carry
+        # A point is in the current front iff no still-alive point dominates it.
+        dominated = jnp.any(dom & alive[:, None], axis=0) & alive
+        front = alive & ~dominated
+        # Degenerate-cycle guard (cannot happen with strict dominance, but
+        # keeps the loop total): if no point is free, take all remaining.
+        front = jnp.where(jnp.any(front), front, alive)
+        rank = jnp.where(front, k, rank)
+        return rank, alive & ~front, k + 1
+
+    rank0 = jnp.full((n,), n, dtype=jnp.int32)
+    rank, _, _ = jax.lax.while_loop(cond, body, (rank0, valid, jnp.int32(0)))
+    return rank
+
+
+def dominance_matrix(Y: jax.Array) -> jax.Array:
+    """Boolean Pareto-dominance matrix: ``dom[i, j]`` iff i dominates j."""
+    n, d = Y.shape
+    D = dominance_degree_matrix(Y)
+    tie = (D == d) & (D.T == d)
+    D = jnp.where(tie, 0, D)
+    return D == d

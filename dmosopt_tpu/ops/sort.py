@@ -1,0 +1,112 @@
+"""Multi-objective population ordering: rank + diversity lexsort, truncation.
+
+Replaces reference dmosopt/MOEA.py:242-423 (``sortMO`` / ``orderMO`` /
+``remove_worst`` / ``top_k_MO``) with jittable, mask-aware equivalents
+operating on fixed-capacity arrays.
+"""
+
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu.ops.distances import crowding_distance, euclidean_distance_metric
+from dmosopt_tpu.ops.dominance import non_dominated_rank
+
+_METRICS = {
+    "crowding": crowding_distance,
+    "euclidean": euclidean_distance_metric,
+}
+
+
+def resolve_metric(metric) -> Callable:
+    if callable(metric):
+        return metric
+    try:
+        return _METRICS[metric]
+    except KeyError:
+        raise RuntimeError(f"unknown distance metric {metric!r}") from None
+
+
+def order_mo(
+    x: jax.Array,
+    y: jax.Array,
+    x_distance_metrics: Optional[Sequence] = None,
+    y_distance_metrics: Optional[Sequence] = ("crowding",),
+    mask: jax.Array | None = None,
+):
+    """Permutation ordering the population best-first: primary key =
+    non-dominated rank, then each y-distance (descending), then each
+    x-distance (descending). Matches reference ``orderMO``
+    (dmosopt/MOEA.py:300-347) lexsort semantics.
+
+    Returns (perm, rank_sorted, y_dists_sorted).
+    """
+    rank = non_dominated_rank(y, mask=mask)
+    y_fns = [resolve_metric(m) for m in (y_distance_metrics or [])]
+    x_fns = [resolve_metric(m) for m in (x_distance_metrics or [])]
+    y_dists = [fn(y, mask) if _accepts_mask(fn) else fn(y) for fn in y_fns]
+    x_dists = [fn(x, mask) if _accepts_mask(fn) else fn(x) for fn in x_fns]
+
+    # np.lexsort(keys): LAST key is primary. Reference key order:
+    # ([-xd...], [-yd...], rank) -> rank primary, then y-dists desc, x-dists desc.
+    keys = tuple([-d for d in x_dists] + [-d for d in y_dists] + [rank])
+    perm = jnp.lexsort(keys)
+    y_dists_sorted = tuple(d[perm] for d in y_dists)
+    return perm, rank[perm], y_dists_sorted
+
+
+def _accepts_mask(fn: Callable) -> bool:
+    # Built-in metrics take (Y, mask); user metrics (e.g. feasibility rank)
+    # take a single array.
+    return fn in (crowding_distance, euclidean_distance_metric)
+
+
+def sort_mo(
+    x: jax.Array,
+    y: jax.Array,
+    x_distance_metrics=None,
+    y_distance_metrics=("crowding",),
+    mask: jax.Array | None = None,
+):
+    """Sorted copies of (x, y) best-first plus ranks — reference ``sortMO``
+    (dmosopt/MOEA.py:242-297)."""
+    perm, rank_sorted, y_dists_sorted = order_mo(
+        x, y, x_distance_metrics, y_distance_metrics, mask=mask
+    )
+    return x[perm], y[perm], rank_sorted, y_dists_sorted, perm
+
+
+def remove_worst(
+    population_parm: jax.Array,
+    population_obj: jax.Array,
+    pop: int,
+    x_distance_metrics=None,
+    y_distance_metrics=("crowding",),
+    mask: jax.Array | None = None,
+):
+    """Keep the best ``pop`` individuals (reference dmosopt/MOEA.py:398-423).
+
+    Shapes are static: input capacity may exceed ``pop``; output arrays have
+    leading dimension ``pop``.
+    """
+    xs, ys, rank, _, perm = sort_mo(
+        population_parm,
+        population_obj,
+        x_distance_metrics=x_distance_metrics,
+        y_distance_metrics=y_distance_metrics,
+        mask=mask,
+    )
+    return xs[:pop], ys[:pop], rank[:pop], perm[:pop]
+
+
+def top_k_mo(x, y, top_k: int | None = None):
+    """Top-k by non-dominated sort (reference dmosopt/MOEA.py:350-372);
+    host-side helper used to truncate surrogate training sets."""
+    import numpy as np
+
+    if not isinstance(top_k, int) or x.shape[0] <= top_k:
+        return x, y
+    xs, ys, *_ = sort_mo(jnp.asarray(x), jnp.asarray(y))
+    return np.asarray(xs[:top_k]), np.asarray(ys[:top_k])

@@ -1,0 +1,206 @@
+"""Optimizer strategy framework.
+
+The reference defines a stateful class interface — ``initialize_state`` /
+``generate_strategy`` / ``update_strategy`` mutating ``self.state``
+(reference: dmosopt/MOEA.py:55-188). The TPU redesign keeps that outer
+interface for the epoch engine but makes the inner operations *pure
+functions over pytree states with static shapes*, so a whole
+generate→evaluate→update generation compiles to one XLA program and the
+generation loop runs under ``lax.scan`` when evaluation happens on-device
+(surrogate mode).
+
+Conventions:
+- populations live in fixed-capacity arrays; dynamic sizes become masks
+- all randomness flows through explicit `jax.random` keys
+- hyperparameters that the reference adapts in Python (di_mutation,
+  crossover_prob, ...) are carried *in the state pytree* so adaptation
+  happens in-graph
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu import sampling
+from dmosopt_tpu.utils.prng import as_key
+
+
+class Struct:
+    """Plain attribute bag for optimizer hyperparameters
+    (reference: dmosopt/MOEA.py:26-52)."""
+
+    def __init__(self, **items):
+        self.__dict__.update(items)
+
+    def update(self, items):
+        self.__dict__.update(items)
+
+    def items(self):
+        return self.__dict__.items()
+
+    def __call__(self):
+        return dict(self.__dict__)
+
+    def __getitem__(self, key):
+        return self.__dict__[key]
+
+    def __setitem__(self, key, val):
+        self.__dict__[key] = val
+
+    def __contains__(self, k):
+        return k in self.__dict__
+
+    def __repr__(self):
+        return f"Struct({self.__dict__})"
+
+
+class MOEA:
+    """Base class for multi-objective evolutionary strategies.
+
+    Subclasses implement pure functions:
+      initialize_state(key, x, y, bounds) -> state
+      generate_strategy(key, state)       -> (x_gen, state)
+      update_strategy(state, x_gen, y_gen) -> state
+      get_population_strategy(state)      -> (x, y)
+    """
+
+    def __init__(self, name: str, popsize: int, nInput: int, nOutput: int, **kwargs):
+        self.name = name
+        self.popsize = int(popsize)
+        self.nInput = int(nInput)
+        self.nOutput = int(nOutput)
+        self.opt_params = Struct(**self.default_parameters)
+        self.opt_params.update(
+            {
+                "popsize": self.popsize,
+                "nInput": self.nInput,
+                "nOutput": self.nOutput,
+                "initial_size": self.popsize,
+                "initial_sampling_method": None,
+                "initial_sampling_method_params": None,
+            }
+        )
+        for k, v in kwargs.items():
+            if k not in self.opt_params or v is not None:
+                self.opt_params[k] = v
+        self.state = None
+        self._jit_generate = None
+        self._jit_update = None
+
+    @property
+    def default_parameters(self) -> Dict[str, Any]:
+        return {}
+
+    @property
+    def opt_parameters(self) -> Dict[str, Any]:
+        return self.opt_params()
+
+    # ------------------------------------------------------------- host API
+
+    def initialize_strategy(self, x, y, bounds, random=None, **params):
+        """Initialize from evaluated points. ``bounds`` is (n, 2)."""
+        self.bounds = jnp.asarray(bounds, dtype=jnp.float32)
+        key = as_key(random)
+        self.key, init_key = jax.random.split(key)
+        self.state = self.initialize_state(
+            init_key,
+            jnp.asarray(x, dtype=jnp.float32),
+            jnp.asarray(y, dtype=jnp.float32),
+            self.bounds,
+        )
+        return self.state
+
+    def generate(self, **params):
+        """One generation of candidates, clipped to bounds."""
+        self.key, k = jax.random.split(self.key)
+        if self._jit_generate is None:
+            self._jit_generate = jax.jit(self.generate_strategy)
+        x, state = self._jit_generate(k, self.state)
+        x = jnp.clip(x, self.bounds[:, 0], self.bounds[:, 1])
+        self.state = state  # persist bookkeeping (e.g. operator tags) even if
+        # the caller doesn't thread state into update()
+        return x, state
+
+    def update(self, x, y, state=None, **params):
+        if self._jit_update is None:
+            self._jit_update = jax.jit(self.update_strategy)
+        self.state = self._jit_update(
+            state if state is not None else self.state,
+            jnp.asarray(x, dtype=jnp.float32),
+            jnp.asarray(y, dtype=jnp.float32),
+        )
+        return self.state
+
+    @property
+    def population_objectives(self):
+        return self.get_population_strategy(self.state)
+
+    def generate_initial(self, bounds, random=None):
+        """Initial design for strategy bootstrap
+        (reference: dmosopt/MOEA.py:118-143)."""
+        bounds = np.asarray(bounds)
+        xlb, xub = bounds[:, 0], bounds[:, 1]
+        n = self.opt_params.initial_size
+        method = self.opt_params.initial_sampling_method
+        method_params = self.opt_params.initial_sampling_method_params
+        if method is None:
+            x = sampling.lh(n, self.nInput, random)
+            x = x * (xub - xlb) + xlb
+        elif isinstance(method, str):
+            fn = getattr(sampling, method, None)
+            if fn is None:
+                raise RuntimeError(f"unknown sampling method {method!r}")
+            x = fn(n, self.nInput, random) * (xub - xlb) + xlb
+        elif callable(method):
+            if method_params is None:
+                x = method(random, n, self.nInput, xlb, xub)
+            else:
+                x = method(random, **method_params)
+        else:
+            raise RuntimeError(f"unknown sampling method {method}")
+        return x
+
+    # ----------------------------------------------------- pure functions
+
+    def initialize_state(self, key, x, y, bounds):
+        raise NotImplementedError
+
+    def generate_strategy(self, key, state):
+        raise NotImplementedError
+
+    def update_strategy(self, state, x_gen, y_gen):
+        raise NotImplementedError
+
+    def get_population_strategy(self, state):
+        raise NotImplementedError
+
+
+def run_ea_loop(
+    opt: MOEA,
+    state: Any,
+    key: jax.Array,
+    n_generations: int,
+    eval_fn: Callable[[jax.Array], jax.Array],
+) -> Tuple[Any, Dict[str, jax.Array]]:
+    """Scan ``n_generations`` of generate→evaluate→update as one jitted
+    program. ``eval_fn`` must be a jax-traceable batch objective (surrogate
+    predictor or analytic benchmark). This is the on-device replacement for
+    the reference's per-generation Python loop (dmosopt/MOASMO.py:83-116).
+    """
+    bounds = opt.bounds
+
+    def step(state, k):
+        kg, _ = jax.random.split(k)
+        x_gen, state = opt.generate_strategy(kg, state)
+        x_gen = jnp.clip(x_gen, bounds[:, 0], bounds[:, 1])
+        y_gen = eval_fn(x_gen)
+        state = opt.update_strategy(state, x_gen, y_gen)
+        return state, None
+
+    keys = jax.random.split(key, n_generations)
+    state, _ = jax.lax.scan(step, state, keys)
+    return state

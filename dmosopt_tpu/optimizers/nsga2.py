@@ -1,0 +1,270 @@
+"""NSGA-II, TPU-native.
+
+Algorithm semantics follow the reference (dmosopt/NSGA2.py:18-316):
+tournament selection on rank into a half-size mating pool, SBX crossover +
+polynomial mutation, elitist survival by non-dominated rank then crowding
+distance, optional success-rate-driven adaptation of operator rates.
+
+TPU redesign of the generation step: the reference emits a *variable*
+number of offspring from a stochastic while-loop (NSGA2.py:142-178). Here
+each generation emits a fixed batch of ``popsize`` offspring — ``popsize/2``
+slots each produce either an SBX child pair (probability ``crossover_prob``
+renormalized against ``mutation_prob``) or two mutated parents — so the
+whole step is one fused XLA program with static shapes, scannable over
+generations. Adaptive operator rates update in-graph (hyperparameters live
+in the state pytree).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu.optimizers.base import MOEA
+from dmosopt_tpu.ops import (
+    crowding_distance,
+    non_dominated_rank,
+    polynomial_mutation,
+    sbx_crossover,
+    sort_mo,
+    tournament_selection,
+)
+
+
+class NSGA2State(NamedTuple):
+    population_parm: jax.Array  # (pop, n)
+    population_obj: jax.Array  # (pop, d)
+    rank: jax.Array  # (pop,)
+    bounds: jax.Array  # (n, 2)
+    # adaptive hyperparameters (in-graph; reference keeps them in opt_params)
+    di_crossover: jax.Array  # (n,)
+    di_mutation: jax.Array  # (n,)
+    crossover_prob: jax.Array  # ()
+    mutation_prob: jax.Array  # ()
+    mutation_rate: jax.Array  # ()
+    successful_crossovers: jax.Array  # ()
+    total_crossovers: jax.Array  # ()
+    successful_mutations: jax.Array  # ()
+    total_mutations: jax.Array  # ()
+    last_is_crossover: jax.Array  # (2*(pop//2),) operator tag per offspring slot
+
+
+class NSGA2(MOEA):
+    def __init__(
+        self,
+        popsize: int,
+        nInput: int,
+        nOutput: int,
+        model=None,
+        distance_metric="crowding",
+        optimize_mean_variance: bool = False,
+        **kwargs,
+    ):
+        super().__init__(
+            name="NSGA2", popsize=popsize, nInput=nInput, nOutput=nOutput, **kwargs
+        )
+        self.model = model
+        self.distance_metric = distance_metric
+        self.optimize_mean_variance = optimize_mean_variance
+        self.y_distance_metrics = [distance_metric] if distance_metric else None
+        self.x_distance_metrics = None
+        feasibility = getattr(model, "feasibility", None) if model is not None else None
+        if feasibility is not None:
+            self.x_distance_metrics = [feasibility.rank]
+        if self.opt_params.mutation_rate is None:
+            self.opt_params.mutation_rate = 1.0 / float(nInput)
+        self.opt_params.poolsize = int(round(self.popsize / 2.0))
+
+    @property
+    def default_parameters(self) -> Dict[str, Any]:
+        # Reference defaults: dmosopt/NSGA2.py:66-83.
+        return {
+            "crossover_prob": 0.9,
+            "mutation_prob": 0.1,
+            "mutation_rate": None,
+            "nchildren": 1,
+            "di_crossover": 1.0,
+            "di_mutation": 20.0,
+            "min_success_rate": 0.2,
+            "max_success_rate": 0.75,
+            "adaptive_operator_rates": False,
+        }
+
+    # ------------------------------------------------------------ pure fns
+
+    def initialize_state(self, key, x, y, bounds) -> NSGA2State:
+        n = self.nInput
+        pop = self.popsize
+        xs, ys, rank, _, _ = sort_mo(
+            x,
+            y,
+            x_distance_metrics=self.x_distance_metrics,
+            y_distance_metrics=self.y_distance_metrics,
+        )
+        f32 = xs.dtype
+        return NSGA2State(
+            population_parm=xs[:pop],
+            population_obj=ys[:pop],
+            rank=rank[:pop],
+            bounds=bounds,
+            di_crossover=jnp.broadcast_to(
+                jnp.asarray(self.opt_params.di_crossover, f32), (n,)
+            ),
+            di_mutation=jnp.broadcast_to(
+                jnp.asarray(self.opt_params.di_mutation, f32), (n,)
+            ),
+            crossover_prob=jnp.asarray(self.opt_params.crossover_prob, f32),
+            mutation_prob=jnp.asarray(self.opt_params.mutation_prob, f32),
+            mutation_rate=jnp.asarray(self.opt_params.mutation_rate, f32),
+            successful_crossovers=jnp.zeros((), f32),
+            total_crossovers=jnp.zeros((), f32),
+            successful_mutations=jnp.zeros((), f32),
+            total_mutations=jnp.zeros((), f32),
+            last_is_crossover=jnp.zeros((2 * (pop // 2),), bool),
+        )
+
+    def generate_strategy(self, key, state: NSGA2State):
+        pop = self.popsize
+        poolsize = self.opt_params.poolsize
+        npairs = pop // 2
+        xlb, xub = state.bounds[:, 0], state.bounds[:, 1]
+
+        k_pool, k_pick, k_op, k_sbx, k_mut = jax.random.split(key, 5)
+
+        pool_idx = tournament_selection(k_pool, poolsize, state.rank)
+        pool = state.population_parm[pool_idx]
+
+        # Two distinct parents per pair slot.
+        i1 = jax.random.randint(k_pick, (npairs,), 0, poolsize)
+        shift = jax.random.randint(jax.random.fold_in(k_pick, 1), (npairs,), 1, poolsize)
+        i2 = (i1 + shift) % poolsize
+        p1, p2 = pool[i1], pool[i2]
+
+        # Choose operator per slot with the reference's relative frequencies:
+        # a crossover event yields 2 children at rate pc, a mutation event 1
+        # child at rate pm -> P(slot is crossover) = 2 pc / (2 pc + pm).
+        pc, pm = state.crossover_prob, state.mutation_prob
+        p_slot_x = (2.0 * pc) / (2.0 * pc + pm)
+        is_x = jax.random.bernoulli(k_op, p_slot_x, (npairs,))
+
+        c1, c2 = sbx_crossover(k_sbx, p1, p2, state.di_crossover, xlb, xub)
+        m1 = polynomial_mutation(
+            k_mut, p1, state.di_mutation, xlb, xub, state.mutation_rate
+        )
+        m2 = polynomial_mutation(
+            jax.random.fold_in(k_mut, 1),
+            p2,
+            state.di_mutation,
+            xlb,
+            xub,
+            state.mutation_rate,
+        )
+        o1 = jnp.where(is_x[:, None], c1, m1)
+        o2 = jnp.where(is_x[:, None], c2, m2)
+        x_gen = jnp.concatenate([o1, o2], axis=0)  # (2*npairs, n)
+
+        # Operator bookkeeping for adaptive rates: offspring slot i and
+        # i+npairs share one operator draw.
+        is_x2 = jnp.concatenate([is_x, is_x])
+        state = state._replace(
+            total_crossovers=state.total_crossovers + is_x.sum(),
+            total_mutations=state.total_mutations + 2.0 * (~is_x).sum(),
+            last_is_crossover=is_x2,
+        )
+        return x_gen, state
+
+    def update_strategy(self, state: NSGA2State, x_gen, y_gen) -> NSGA2State:
+        pop = self.popsize
+        noff = x_gen.shape[0]
+
+        parm = jnp.concatenate([x_gen, state.population_parm], axis=0)
+        obj = jnp.concatenate([y_gen, state.population_obj], axis=0)
+
+        xs, ys, rank, _, perm = sort_mo(
+            parm,
+            obj,
+            x_distance_metrics=self.x_distance_metrics,
+            y_distance_metrics=self.y_distance_metrics,
+        )
+        keep = perm[:pop]
+        survived_off = keep < noff  # offspring that made it
+
+        state = state._replace(
+            population_parm=xs[:pop],
+            population_obj=ys[:pop],
+            rank=rank[:pop],
+        )
+
+        if self.opt_params.adaptive_operator_rates:
+            is_x = state.last_is_crossover
+            surv_idx = jnp.where(survived_off, keep, noff)  # noff = sentinel
+            is_x_pad = jnp.concatenate([is_x, jnp.zeros((1,), bool)])
+            surv_is_x = is_x_pad[surv_idx] & survived_off
+            n_surv_x = surv_is_x.sum() / 2.0
+            n_surv_m = (survived_off & ~is_x_pad[surv_idx]).sum()
+            state = state._replace(
+                successful_crossovers=state.successful_crossovers + n_surv_x,
+                successful_mutations=state.successful_mutations + n_surv_m,
+            )
+            state = self._adapt_rates(state)
+        return state
+
+    def _adapt_rates(self, state: NSGA2State) -> NSGA2State:
+        """Success-rate-driven operator adaptation, in-graph
+        (reference: dmosopt/NSGA2.py:267-316)."""
+        lo = self.opt_params.min_success_rate
+        hi = self.opt_params.max_success_rate
+
+        def adapt(di, prob, rate, succ, total, is_mutation):
+            sr = jnp.where(total > 0, succ / jnp.maximum(total, 1.0), 0.5)
+            explore = (sr < lo) & (total > 0)
+            exploit = (sr > hi) & (total > 0)
+            di = jnp.where(
+                explore, jnp.maximum(1.0, di * 0.9), jnp.where(exploit, jnp.minimum(100.0, di * 1.1), di)
+            )
+            if is_mutation:
+                prob_up = jnp.minimum(1.0 - state.crossover_prob, prob * 1.05)
+                prob_dn = jnp.maximum(0.1, prob * 0.9)
+                rate_up = jnp.minimum(0.95, rate * 1.1)
+                rate_dn = jnp.maximum(0.05 / self.nInput, rate * 0.9)
+                rate = jnp.where(explore, rate_up, jnp.where(exploit, rate_dn, rate))
+            else:
+                prob_up = jnp.minimum(0.95, prob * 1.1)
+                prob_dn = jnp.maximum(0.5, prob * 0.9)
+            prob = jnp.where(explore, prob_up, jnp.where(exploit, prob_dn, prob))
+            return di, prob, rate
+
+        di_x, pc, _ = adapt(
+            state.di_crossover,
+            state.crossover_prob,
+            state.mutation_rate,
+            state.successful_crossovers,
+            state.total_crossovers,
+            False,
+        )
+        di_m, pm, mr = adapt(
+            state.di_mutation,
+            state.mutation_prob,
+            state.mutation_rate,
+            state.successful_mutations,
+            state.total_mutations,
+            True,
+        )
+        z = jnp.zeros((), state.crossover_prob.dtype)
+        return state._replace(
+            di_crossover=di_x,
+            di_mutation=di_m,
+            crossover_prob=pc,
+            mutation_prob=pm,
+            mutation_rate=mr,
+            successful_crossovers=z,
+            total_crossovers=z,
+            successful_mutations=z,
+            total_mutations=z,
+        )
+
+    def get_population_strategy(self, state=None):
+        state = state if state is not None else self.state
+        return state.population_parm, state.population_obj

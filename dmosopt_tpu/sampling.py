@@ -1,0 +1,217 @@
+"""Design-of-experiments samplers: MC, Latin hypercube, symmetric LH, Sobol,
+good lattice points, with optional RGS de-correlation.
+
+Same sampler menu and shorthand API as the reference
+(dmosopt/sampling.py:156-187, dmosopt/GLP.py:14-28): every sampler maps
+``(n, s, random, maxiter) -> (n, s)`` points in the unit box. Randomness may
+be an int seed, a numpy Generator, or a JAX PRNG key. LH/MC generate on
+device; GLP scores all candidate lattices with a vmapped centered-L2
+discrepancy instead of a Python loop; Sobol uses scipy's direction numbers
+host-side (one-shot initial design, not a hot path).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu.discrepancy import CD2
+from dmosopt_tpu.utils.prng import as_generator, as_key
+
+
+# ------------------------------------------------------------------ basic
+
+
+def MonteCarloDesign(n: int, s: int, random=None) -> np.ndarray:
+    key = as_key(random)
+    return np.asarray(jax.random.uniform(key, (n, s)))
+
+
+def LatinHypercubeDesign(n: int, s: int, random=None) -> np.ndarray:
+    """Standard LH: per dimension, one uniform draw in each of n strata,
+    independently permuted."""
+    key = as_key(random)
+    kperm, ku = jax.random.split(key)
+    perms = jax.vmap(lambda k: jax.random.permutation(k, n))(
+        jax.random.split(kperm, s)
+    )  # (s, n)
+    u = jax.random.uniform(ku, (n, s))
+    x = (perms.T.astype(u.dtype) + u) / n
+    return np.asarray(x)
+
+
+def SymmetricLatinHypercubeDesign(n: int, s: int, random=None) -> np.ndarray:
+    """Symmetric LH (reference: dmosopt/sampling.py:43-77): strata centers
+    with mirrored pairing — rows i and n-1-i use complementary strata."""
+    rng = as_generator(random)
+    k = n // 2
+    p = np.zeros((n, s), dtype=int)
+    p[:, 0] = np.arange(n)
+    if n % 2 == 1:
+        p[k, :] = k
+    for j in range(1, s):
+        pj = rng.permutation(k)
+        flip = rng.random(k) < 0.5
+        # flip: bottom keeps pj, top gets mirror; else bottom gets mirror.
+        p[:k, j] = np.where(flip, pj, n - 1 - pj)
+        p[n - 1 : n - 1 - k : -1, j] = np.where(flip, n - 1 - pj, pj)
+    return (p + 0.5) / n
+
+
+def SobolDesign(n: int, s: int, random=None) -> np.ndarray:
+    """Scrambled Sobol sequence, generated in power-of-two blocks and
+    truncated (reference: dmosopt/sampling.py:11-22)."""
+    from scipy.stats import qmc
+
+    rng = as_generator(random)
+    sampler = qmc.Sobol(d=s, scramble=True, seed=rng)
+    m = max(1, math.ceil(math.log2(max(n, 2))))
+    sample = sampler.random_base2(m)
+    return np.asarray(sample[:n])
+
+
+# ------------------------------------------------------------------- GLP
+
+
+def _prime_factors(n: int) -> list[int]:
+    p, f = [], 2
+    while f * f <= n:
+        while n % f == 0:
+            p.append(f)
+            n //= f
+        f += 1
+    if n > 1:
+        p.append(n)
+    return p
+
+
+def euler_phi(n: int) -> int:
+    phi = n
+    for f in sorted(set(_prime_factors(n))):
+        phi -= phi // f
+    return phi
+
+
+def _lattice_points(n: int, h: np.ndarray) -> np.ndarray:
+    """u[i, j] = ((i+1) * h[j] - 1) mod n + 1 (reference glpmod,
+    dmosopt/GLP.py:130-139, where a 0 residue means n)."""
+    i = np.arange(1, n + 1)[:, None]
+    u = (i * h[None, :]) % n
+    u = np.where(u == 0, n, u)
+    return u.astype(float)
+
+
+def _power_gen_vectors(n: int, s: int) -> np.ndarray:
+    """Candidate generating vectors h = (a^0, ..., a^(s-1)) mod n for units a
+    whose first s powers are distinct and != 1 (reference dmosopt/GLP.py:105-127)."""
+    cands = []
+    for a in range(2, n):
+        if math.gcd(a, n) != 1:
+            continue
+        powers = np.mod([pow(a, t, n) for t in range(1, s)], n)
+        sp = np.sort(powers)
+        if sp[0] == 1 or np.any(sp[1:] == sp[:-1]):
+            continue
+        cands.append([pow(a, t, n) for t in range(s)])
+    return np.asarray(cands, dtype=float)
+
+
+def _score_and_pick(designs: np.ndarray) -> np.ndarray:
+    """Pick the candidate design with minimum centered L2 discrepancy;
+    scoring is one vmapped jitted kernel over all candidates."""
+    scores = jax.vmap(CD2)(jnp.asarray(designs))
+    return designs[int(jnp.argmin(scores))]
+
+
+def GoodLatticePointsDesign(n: int, s: int, random=None) -> np.ndarray:
+    """Number-theoretic uniform design (reference dmosopt/GLP.py:14-28):
+    when the Euler totient of n is too small, use n+1 points and drop the
+    last row; small cases enumerate totative combinations, large cases use
+    power generating vectors."""
+    if s == 1:
+        return LatinHypercubeDesign(n, 1, random)
+    m = euler_phi(n)
+    plusone = (m / n) < 0.9
+    small = m < 20 and s < 4  # branch on phi(n) before any n+1 adjustment
+    nn = n + 1 if plusone else n
+    m = euler_phi(nn) if plusone else m
+    if small:
+        h_all = np.asarray([i for i in range(nn) if math.gcd(i, nn) == 1])
+        u = _lattice_points(nn, h_all)
+        combos = list(itertools.combinations(range(len(h_all)), s))
+        designs = np.stack([u[:, list(c)] for c in combos])
+    else:
+        hs = _power_gen_vectors(nn, s)
+        if len(hs) == 0:
+            return LatinHypercubeDesign(n, s, random)
+        designs = np.stack([_lattice_points(nn, h) for h in hs])
+
+    if plusone:
+        designs = (designs[:, : nn - 1, :] - 0.5) / (nn - 1)
+    else:
+        designs = (designs - 0.5) / nn
+    return np.asarray(_score_and_pick(designs))
+
+
+# ------------------------------------------------- RGS de-correlation
+
+
+def _rmtrend(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    xm = x - x.mean()
+    ym = y - y.mean()
+    b = (xm * ym).sum() / (xm**2).sum()
+    return y - b * xm
+
+
+def _rank_to_unit(z: np.ndarray) -> np.ndarray:
+    n = len(z)
+    x = np.empty(n)
+    x[z.argsort()] = np.arange(n)
+    return (x + 0.5) / n
+
+
+def decorr(x: np.ndarray) -> np.ndarray:
+    """One Ranked Gram-Schmidt de-correlation iteration
+    (reference: dmosopt/sampling.py:97-109)."""
+    x = np.array(x, copy=True)
+    n, s = x.shape
+    for j in range(1, s):
+        for k in range(j):
+            x[:, k] = _rank_to_unit(_rmtrend(x[:, j], x[:, k]))
+    for j in range(s - 2, -1, -1):
+        for k in range(s - 1, j, -1):
+            x[:, k] = _rank_to_unit(_rmtrend(x[:, j], x[:, k]))
+    return x
+
+
+def _with_decorr(x: np.ndarray, maxiter: int) -> np.ndarray:
+    for _ in range(maxiter):
+        x = decorr(x)
+    return x
+
+
+# ------------------------------------------------------------ short names
+
+
+def mc(n, s, random=None, maxiter=0):
+    return MonteCarloDesign(n, s, random)
+
+
+def lh(n, s, random=None, maxiter=0):
+    return _with_decorr(LatinHypercubeDesign(n, s, random), maxiter)
+
+
+def slh(n, s, random=None, maxiter=0):
+    return _with_decorr(SymmetricLatinHypercubeDesign(n, s, random), maxiter)
+
+
+def glp(n, s, random=None, maxiter=0):
+    return _with_decorr(GoodLatticePointsDesign(n, s, random), maxiter)
+
+
+def sobol(n, s, random=None, maxiter=0):
+    return SobolDesign(n, s, random)

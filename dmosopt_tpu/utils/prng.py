@@ -1,0 +1,56 @@
+"""Randomness plumbing: one place to normalize seeds.
+
+The reference threads `numpy.random.Generator` objects through every
+signature (e.g. dmosopt/MOEA.py:100-143). Here device code threads
+`jax.random` keys; host-side sampling helpers (Sobol via scipy, RGS
+decorrelation) need numpy Generators. These helpers accept an int seed, a
+numpy Generator, or a JAX key and produce whichever form is needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def is_jax_key(x) -> bool:
+    if isinstance(x, jax.Array):
+        try:
+            return jnp.issubdtype(x.dtype, jax.dtypes.prng_key) or (
+                x.dtype == jnp.uint32 and x.shape == (2,)
+            )
+        except Exception:
+            return False
+    return False
+
+
+def as_key(random) -> jax.Array:
+    """Normalize to a jax PRNG key."""
+    if random is None:
+        return jax.random.PRNGKey(0)
+    if is_jax_key(random):
+        return random
+    if isinstance(random, (int, np.integer)):
+        return jax.random.PRNGKey(int(random))
+    if isinstance(random, np.random.Generator):
+        return jax.random.PRNGKey(int(random.integers(0, 2**31 - 1)))
+    raise TypeError(f"cannot convert {type(random)} to a jax PRNG key")
+
+
+def as_generator(random) -> np.random.Generator:
+    """Normalize to a numpy Generator (for host-side one-shot sampling)."""
+    if random is None:
+        return np.random.default_rng()
+    if isinstance(random, np.random.Generator):
+        return random
+    if isinstance(random, (int, np.integer)):
+        return np.random.default_rng(int(random))
+    if is_jax_key(random):
+        data = np.asarray(jax.random.key_data(random)).ravel()
+        return np.random.default_rng(int(data[-1]))
+    raise TypeError(f"cannot convert {type(random)} to a numpy Generator")
+
+
+def as_seed(random) -> int:
+    return int(as_generator(random).integers(0, 2**31 - 1))

@@ -1,0 +1,24 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+The driver benches on a real TPU chip; tests exercise the same jitted code
+paths on CPU with XLA's host-platform device-count override so multi-device
+sharding is tested without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,82 @@
+"""ParameterSpace flatten/unflatten round-trips and ordering invariants.
+
+Oracle pattern follows reference tests/test_parameter_space.py and
+tests/test_parameter_space_order.py: nested round-trips and stable sorted
+parameter ordering.
+"""
+
+import numpy as np
+
+from dmosopt_tpu.datatypes import ParameterSpace, update_nested_dict
+
+
+NESTED = {
+    "soma": {
+        "gkabar_kap": [0.001, 0.1, False],
+        "gkdrbar_kdr": [0.001, 0.1],
+    },
+    "axon": {"gbar_nax": [0.01, 0.2]},
+    "dend": {
+        "deep": {"a": [0.0, 1.0], "b": [2.0, 3.0, True]},
+    },
+}
+
+
+def test_flatten_order_is_sorted_depth_first():
+    space = ParameterSpace.from_dict(NESTED)
+    assert space.parameter_names == [
+        "axon.gbar_nax",
+        "dend.deep.a",
+        "dend.deep.b",
+        "soma.gkabar_kap",
+        "soma.gkdrbar_kdr",
+    ]
+    assert space.n_parameters == 5
+    np.testing.assert_allclose(space.bound1, [0.01, 0.0, 2.0, 0.001, 0.001])
+    np.testing.assert_allclose(space.bound2, [0.2, 1.0, 3.0, 0.1, 0.1])
+    np.testing.assert_array_equal(
+        space.is_integer, [False, False, True, False, False]
+    )
+
+
+def test_roundtrip_flatten_unflatten():
+    space = ParameterSpace.from_dict(NESTED)
+    flat = np.array([0.15, 0.5, 2.0, 0.05, 0.02])
+    nested = space.unflatten(flat)
+    assert nested["axon"]["gbar_nax"] == 0.15
+    assert nested["dend"]["deep"]["b"] == 2.0
+    back = space.flatten(nested)
+    np.testing.assert_allclose(back, flat)
+
+
+def test_flat_space():
+    space = ParameterSpace.from_dict({"x": [0.0, 1.0], "y": [-1.0, 1.0]})
+    assert space.parameter_names == ["x", "y"]
+    d = space.unflatten(np.array([0.3, 0.7]))
+    assert d == {"x": 0.3, "y": 0.7}
+
+
+def test_value_space():
+    space = ParameterSpace.from_dict({"a": 1.5, "b": {"c": 2}}, is_value_only=True)
+    assert space.is_value_space
+    np.testing.assert_allclose(space.parameter_values, [1.5, 2.0])
+    assert space.unflatten() == {"a": 1.5, "b": {"c": 2.0}}
+
+
+def test_bounds_property_shape():
+    space = ParameterSpace.from_dict(NESTED)
+    assert space.bounds.shape == (5, 2)
+    assert (space.bounds[:, 0] <= space.bounds[:, 1]).all()
+
+
+def test_swapped_bounds_normalized():
+    space = ParameterSpace.from_dict({"x": [1.0, 0.0]})
+    assert space.bound1[0] == 0.0 and space.bound2[0] == 1.0
+
+
+def test_update_nested_dict():
+    base = {"a": {"b": 1, "c": 2}, "d": 3}
+    upd = {"a": {"c": 5}, "e": 6}
+    out = update_nested_dict(base, upd)
+    assert out == {"a": {"b": 1, "c": 5}, "d": 3, "e": 6}
+    assert base == {"a": {"b": 1, "c": 2}, "d": 3}

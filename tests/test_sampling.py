@@ -1,0 +1,129 @@
+"""Sampler + discrepancy tests: LH stratification invariants, symmetric LH
+mirror property, GLP lattice structure, discrepancy formulas vs naive oracles."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dmosopt_tpu import sampling
+from dmosopt_tpu import discrepancy
+
+
+def naive_cd2(X):
+    num, dim = X.shape
+    D1 = (13.0 / 12.0) ** dim
+    D2 = 0.0
+    D3 = 0.0
+    for k in range(num):
+        DD2 = 1.0
+        for j in range(dim):
+            DD2 *= 1 + 0.5 * abs(X[k, j] - 0.5) - 0.5 * abs(X[k, j] - 0.5) ** 2
+        D2 += DD2
+        for j in range(num):
+            DD3 = 1.0
+            for i in range(dim):
+                DD3 *= (
+                    1
+                    + 0.5 * abs(X[k, i] - 0.5)
+                    + 0.5 * abs(X[j, i] - 0.5)
+                    - 0.5 * abs(X[k, i] - X[j, i])
+                )
+            D3 += DD3
+    return np.sqrt(D1 - 2.0 * D2 / num + D3 / num**2)
+
+
+def naive_wd2(X):
+    num, dim = X.shape
+    D3 = 0.0
+    for k in range(num):
+        for j in range(num):
+            DD3 = 1.0
+            for i in range(dim):
+                a = abs(X[k, i] - X[j, i])
+                DD3 *= 1.5 - a * (1 - a)
+            D3 += DD3
+    return np.sqrt(-((4.0 / 3.0) ** dim) + D3 / num**2)
+
+
+@pytest.mark.parametrize("name", ["mc", "lh", "slh", "sobol", "glp"])
+def test_samplers_in_unit_box(name):
+    fn = getattr(sampling, name)
+    x = fn(33, 4, 7)
+    assert x.shape == (33, 4)
+    assert (x >= 0).all() and (x <= 1).all()
+
+
+def test_lh_stratification():
+    n, s = 50, 3
+    x = sampling.lh(n, s, 123)
+    # exactly one point per stratum per dimension
+    for j in range(s):
+        strata = np.floor(x[:, j] * n).astype(int)
+        assert sorted(strata.tolist()) == list(range(n))
+
+
+def test_slh_symmetry():
+    n, s = 20, 4
+    x = sampling.slh(n, s, 5)
+    # rows i and n-1-i are mirrors: x[i] + x[n-1-i] == 1 elementwise
+    np.testing.assert_allclose(x + x[::-1], 1.0, atol=1e-12)
+    # and it is still an LH
+    for j in range(s):
+        strata = np.floor(x[:, j] * n).astype(int)
+        assert sorted(strata.tolist()) == list(range(n))
+
+
+def test_slh_odd_n():
+    x = sampling.slh(21, 3, 11)
+    np.testing.assert_allclose(x + x[::-1], 1.0, atol=1e-12)
+
+
+def test_sobol_low_discrepancy():
+    x = sampling.sobol(64, 2, 3)
+    r = sampling.mc(64, 2, 3)
+    assert float(discrepancy.CD2(jnp.asarray(x))) < float(
+        discrepancy.CD2(jnp.asarray(r))
+    )
+
+
+def test_glp_beats_random_cd2():
+    x = sampling.glp(21, 3, 3)
+    assert x.shape == (21, 3)
+    cds = [
+        float(discrepancy.CD2(jnp.asarray(sampling.mc(21, 3, seed))))
+        for seed in range(5)
+    ]
+    assert float(discrepancy.CD2(jnp.asarray(x))) < min(cds)
+
+
+def test_cd2_matches_naive(rng):
+    X = rng.random((17, 3))
+    np.testing.assert_allclose(
+        float(discrepancy.CD2(jnp.asarray(X))), naive_cd2(X), rtol=1e-5
+    )
+
+
+def test_wd2_matches_naive(rng):
+    X = rng.random((11, 4))
+    np.testing.assert_allclose(
+        float(discrepancy.WD2(jnp.asarray(X))), naive_wd2(X), rtol=1e-5
+    )
+
+
+def test_mindist(rng):
+    X = np.array([[0.0, 0.0], [0.3, 0.4], [1.0, 1.0]])
+    np.testing.assert_allclose(float(discrepancy.MinDist(jnp.asarray(X))), 0.5)
+
+
+def test_decorr_reduces_correlation():
+    x = sampling.mc(100, 5, 9)
+    xd = sampling.decorr(x)
+    assert discrepancy.corrscore(xd.T) <= discrepancy.corrscore(x.T) + 1e-9
+
+
+def test_seed_determinism():
+    a = sampling.lh(16, 3, 42)
+    b = sampling.lh(16, 3, 42)
+    np.testing.assert_array_equal(a, b)
+    c = sampling.lh(16, 3, 43)
+    assert not np.array_equal(a, c)
