@@ -5,8 +5,6 @@ Crowding distance replaces the reference's Python double loop
 scatter-add; mask-aware so it composes with fixed-capacity populations.
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
@@ -84,7 +82,7 @@ def pairwise_distances(X: jax.Array, Y: jax.Array | None = None) -> jax.Array:
     return jnp.sqrt(jnp.maximum(sq, 0.0))
 
 
-@partial(jax.jit, static_argnames=())
+@jax.jit
 def duplicate_mask(X: jax.Array, eps: float = 1e-16, mask: jax.Array | None = None) -> jax.Array:
     """Mark rows that duplicate an earlier row (within ``eps`` euclidean
     distance). Matches reference dmosopt/MOEA.py:426-436: only the

@@ -5,7 +5,6 @@ Replaces reference dmosopt/MOEA.py:242-423 (``sortMO`` / ``orderMO`` /
 operating on fixed-capacity arrays.
 """
 
-from functools import partial
 from typing import Callable, Optional, Sequence
 
 import jax

@@ -8,8 +8,6 @@ generation; weighted sampling-without-replacement uses the Gumbel top-k
 trick instead of ``Generator.choice``.
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

@@ -19,7 +19,7 @@ Conventions:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict
 
 import numpy as np
 import jax
@@ -185,7 +185,7 @@ def run_ea_loop(
     key: jax.Array,
     n_generations: int,
     eval_fn: Callable[[jax.Array], jax.Array],
-) -> Tuple[Any, Dict[str, jax.Array]]:
+) -> Any:
     """Scan ``n_generations`` of generate→evaluate→update as one jitted
     program. ``eval_fn`` must be a jax-traceable batch objective (surrogate
     predictor or analytic benchmark). This is the on-device replacement for

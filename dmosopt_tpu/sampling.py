@@ -141,8 +141,10 @@ def GoodLatticePointsDesign(n: int, s: int, random=None) -> np.ndarray:
     m = euler_phi(nn) if plusone else m
     if small:
         h_all = np.asarray([i for i in range(nn) if math.gcd(i, nn) == 1])
-        u = _lattice_points(nn, h_all)
         combos = list(itertools.combinations(range(len(h_all)), s))
+        if len(combos) == 0:  # fewer totatives than dims (reference falls
+            return LatinHypercubeDesign(n, s, random)  # back to random design)
+        u = _lattice_points(nn, h_all)
         designs = np.stack([u[:, list(c)] for c in combos])
     else:
         hs = _power_gen_vectors(nn, s)

@@ -46,7 +46,10 @@ def test_nsga2_converges_on_zdt1():
     popsize, dim = 100, 30
     opt = _setup(popsize=popsize, dim=dim, seed=1)
     key = jax.random.PRNGKey(2)
-    state = run_ea_loop(opt, opt.state, key, n_generations=200, eval_fn=zdt1)
+    # 300 generations: the reference oracle budget is 4 MOASMO epochs x ~200
+    # surrogate generations (tests/test_zdt1_nsga2_trs.py:117); a direct-EA
+    # run needs a comparable budget and 200 is seed-marginal.
+    state = run_ea_loop(opt, opt.state, key, n_generations=300, eval_fn=zdt1)
     y = np.asarray(state.population_obj)
     dists = distance_to_front(y, zdt1_pareto(1000))
     n_on_front = int((dists <= 0.01).sum())
