@@ -1,3 +1,4 @@
+from dmosopt_tpu.ops.filtering import filter_samples  # noqa: F401
 from dmosopt_tpu.ops.dominance import (  # noqa: F401
     comparison_matrix,
     dominance_degree_matrix,

@@ -78,7 +78,8 @@ def pairwise_distances(X: jax.Array, Y: jax.Array | None = None) -> jax.Array:
         Y = X
     x2 = jnp.sum(X**2, axis=1, keepdims=True)
     y2 = jnp.sum(Y**2, axis=1, keepdims=True)
-    sq = x2 + y2.T - 2.0 * (X @ Y.T)
+    # highest precision: TPU bf16 matmul default breaks the cancellation
+    sq = x2 + y2.T - 2.0 * jnp.matmul(X, Y.T, precision="highest")
     return jnp.sqrt(jnp.maximum(sq, 0.0))
 
 

@@ -15,6 +15,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
+
+# The axon TPU plugin in this image force-overrides JAX_PLATFORMS at import
+# time; an explicit post-import config.update wins and restores the 8-device
+# virtual CPU mesh the suite is designed for.
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.devices()
+
 import numpy as np
 import pytest
 
