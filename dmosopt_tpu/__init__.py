@@ -19,3 +19,23 @@ from dmosopt_tpu.datatypes import (  # noqa: F401
     ParameterSpace,
     StrategyState,
 )
+
+
+def run(dopt_params, **kwargs):
+    """Run a complete MO-ASMO optimization (see dmosopt_tpu.driver.run)."""
+    from dmosopt_tpu.driver import run as _run
+
+    return _run(dopt_params, **kwargs)
+
+
+def __getattr__(name):
+    # lazy heavyweight imports so `import dmosopt_tpu` stays light
+    if name in ("DistOptimizer", "dopt_init"):
+        from dmosopt_tpu import driver
+
+        return getattr(driver, name)
+    if name == "DistOptStrategy":
+        from dmosopt_tpu.strategy import DistOptStrategy
+
+        return DistOptStrategy
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

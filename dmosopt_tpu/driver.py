@@ -1,0 +1,950 @@
+"""Top-level driver: `run()` and `DistOptimizer`.
+
+Capability match: reference `dmosopt/dmosopt.py:546-2571` — the epoch
+driver (request farm-out, stats, persistence triggers, surrogate-accuracy
+logging) and the `run(dopt_params)` entry point.
+
+TPU redesign of the runtime: the reference's MPI controller/worker roles
+and asynchronous task queue (distwq) are deleted. There is one process;
+"farming out" a batch of evaluation requests is a single call into an
+evaluation backend (`dmosopt_tpu.parallel.evaluator`):
+
+- host-Python objectives run inline (the reference's controller-only
+  degenerate mode, dmosopt.py:2452-2458) or over a thread pool,
+- jax-traceable objectives run as ONE jitted batch, sharded over the
+  device mesh (ICI data parallelism — the TPU equivalent of MPI task
+  farming, see SURVEY §5.8).
+
+Multi-problem multiplexing (`problem_ids`), dynamic initial sampling,
+optimizer cycling, save-every-N-evals, and epoch accounting keep the
+reference semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from functools import partial
+from collections.abc import Sequence
+from typing import Dict, Optional
+
+import numpy as np
+
+from dmosopt_tpu import moasmo as opt
+from dmosopt_tpu.config import import_object_by_path
+from dmosopt_tpu.datatypes import (
+    EvalRequest,
+    OptProblem,
+    ParameterSpace,
+    StrategyState,
+    update_nested_dict,
+)
+from dmosopt_tpu.parallel.evaluator import HostFunEvaluator, JaxBatchEvaluator
+from dmosopt_tpu.strategy import DistOptStrategy
+from dmosopt_tpu.utils.prng import as_generator
+
+logger = logging.getLogger(__name__)
+
+dopt_dict: Dict[str, "DistOptimizer"] = {}
+
+
+# ------------------------------------------------------ objective wrappers
+
+
+def eval_obj_fun_sp(
+    obj_fun, pp, param_space, nested_parameter_space, obj_fun_args, problem_id,
+    space_vals,
+):
+    """Single-problem objective evaluation
+    (reference: dmosopt/dmosopt.py:2327-2363)."""
+    this_space_vals = space_vals[problem_id]
+    if nested_parameter_space:
+        this_pp = update_nested_dict(
+            pp.unflatten() if pp is not None else {},
+            param_space.unflatten(this_space_vals),
+        )
+    else:
+        this_pp = {}
+        if pp is not None:
+            this_pp.update(
+                (item.name, int(item.value) if item.is_integer else item.value)
+                for item in pp.items
+            )
+        this_pp.update(
+            (param_name, this_space_vals[i])
+            for i, param_name in enumerate(param_space.parameter_names)
+        )
+    if obj_fun_args is None:
+        obj_fun_args = ()
+    t = time.time()
+    result = obj_fun(this_pp, *obj_fun_args)
+    return {problem_id: result, "time": time.time() - t}
+
+
+def eval_obj_fun_mp(
+    obj_fun, pp, param_space, nested_parameter_space, obj_fun_args, problem_ids,
+    space_vals,
+):
+    """Multi-problem objective evaluation
+    (reference: dmosopt/dmosopt.py:2366-2409). Iterates the problems
+    present in `space_vals` (a subset of `problem_ids` when per-problem
+    request queues have unequal lengths)."""
+    mpp = {}
+    for problem_id in space_vals:
+        this_space_vals = space_vals[problem_id]
+        if nested_parameter_space:
+            this_pp = update_nested_dict(
+                pp.unflatten() if pp is not None else {},
+                param_space.unflatten(this_space_vals),
+            )
+        else:
+            this_pp = {}
+            if pp is not None:
+                this_pp.update(
+                    (item.name, int(item.value) if item.is_integer else item.value)
+                    for item in pp.items
+                )
+            this_pp.update(
+                (param_name, this_space_vals[i])
+                for i, param_name in enumerate(param_space.parameter_names)
+            )
+        mpp[problem_id] = this_pp
+    if obj_fun_args is None:
+        obj_fun_args = ()
+    t = time.time()
+    result_dict = obj_fun(mpp, *obj_fun_args)
+    result_dict["time"] = time.time() - t
+    return result_dict
+
+
+# ----------------------------------------------------------------- driver
+
+
+class DistOptimizer:
+    def __init__(
+        self,
+        opt_id,
+        obj_fun,
+        obj_fun_args=None,
+        objective_names=None,
+        feature_dtypes=None,
+        feature_class=None,
+        constraint_names=None,
+        n_initial=10,
+        initial_maxiter=5,
+        initial_method="slh",
+        dynamic_initial_sampling=None,
+        dynamic_initial_sampling_kwargs=None,
+        verbose=False,
+        reduce_fun=None,
+        reduce_fun_args=None,
+        problem_ids=None,
+        problem_parameters=None,
+        space=None,
+        population_size=100,
+        num_generations=200,
+        resample_fraction=0.25,
+        distance_metric=None,
+        n_epochs=10,
+        save_eval=10,
+        file_path=None,
+        save=False,
+        save_surrogate_evals=False,
+        save_optimizer_params=True,
+        metadata=None,
+        nested_parameter_space=False,
+        surrogate_method_name="gpr",
+        surrogate_method_kwargs=None,
+        surrogate_custom_training=None,
+        surrogate_custom_training_kwargs=None,
+        optimizer_name="nsga2",
+        optimizer_kwargs=None,
+        sensitivity_method_name=None,
+        sensitivity_method_kwargs=None,
+        optimize_mean_variance=False,
+        local_random=None,
+        random_seed=None,
+        feasibility_method_name=None,
+        feasibility_method_kwargs=None,
+        termination_conditions=None,
+        jax_objective=False,
+        evaluator=None,
+        n_eval_workers=1,
+        mesh=None,
+        time_limit=None,
+        **kwargs,
+    ) -> None:
+        """MO-ASMO optimization driver (see reference
+        dmosopt/dmosopt.py:546-630 for the parameter narrative).
+
+        TPU-specific knobs:
+          jax_objective: `obj_fun` is a jax-traceable batch function over
+            (B, n) flat parameter arrays; evaluation runs as one jitted,
+            mesh-sharded call.
+          evaluator: externally constructed evaluation backend.
+          mesh: `jax.sharding.Mesh` for sharded batch evaluation.
+          n_eval_workers: thread-pool width for host objectives.
+        """
+        if (random_seed is not None) and (local_random is not None):
+            raise RuntimeError(
+                "Both random_seed and local_random are specified! "
+                "Only one or the other must be specified. "
+            )
+        if random_seed is not None:
+            local_random = np.random.default_rng(seed=random_seed)
+
+        self.opt_id = opt_id
+        self.verbose = verbose
+        self.population_size = population_size
+        self.num_generations = num_generations
+        self.resample_fraction = min(float(resample_fraction), 1.0)
+        self.distance_metric = distance_metric
+        self.dynamic_initial_sampling = dynamic_initial_sampling
+        self.dynamic_initial_sampling_kwargs = dynamic_initial_sampling_kwargs
+        self.surrogate_method_name = surrogate_method_name
+        self.surrogate_method_kwargs = surrogate_method_kwargs or {}
+        self.surrogate_custom_training = surrogate_custom_training
+        self.surrogate_custom_training_kwargs = surrogate_custom_training_kwargs
+        self.sensitivity_method_name = sensitivity_method_name
+        self.sensitivity_method_kwargs = sensitivity_method_kwargs or {}
+        self.optimizer_name = (
+            optimizer_name
+            if isinstance(optimizer_name, Sequence)
+            and not isinstance(optimizer_name, str)
+            else (optimizer_name,)
+        )
+        if optimizer_kwargs is None:
+            optimizer_kwargs = {"mutation_prob": 0.1, "crossover_prob": 0.9}
+        self.optimizer_kwargs = (
+            optimizer_kwargs
+            if isinstance(optimizer_kwargs, Sequence)
+            else (optimizer_kwargs,)
+        )
+        self.optimize_mean_variance = optimize_mean_variance
+        self.feasibility_method_name = feasibility_method_name
+        self.feasibility_method_kwargs = feasibility_method_kwargs
+        self.termination_conditions = termination_conditions
+        self.metadata = metadata
+        self.local_random = local_random
+        self.random_seed = random_seed
+        self.time_limit = time_limit
+        self.start_time = time.time()
+
+        self.logger = logging.getLogger(opt_id)
+        if self.verbose:
+            self.logger.setLevel(logging.INFO)
+
+        if file_path is None:
+            if problem_parameters is None or space is None:
+                raise ValueError(
+                    "You must specify at least file name `file_path` or problem "
+                    "parameters `problem_parameters` along with a hyperparameter "
+                    "space `space`."
+                )
+            if save:
+                raise ValueError(
+                    "If you want to save you must specify a file name `file_path`."
+                )
+        else:
+            if not os.path.isfile(file_path):
+                if problem_parameters is None or space is None:
+                    raise FileNotFoundError(file_path)
+
+        param_space = None
+        if space is not None:
+            param_space = ParameterSpace.from_dict(space)
+        if problem_parameters is not None:
+            problem_parameters = ParameterSpace.from_dict(
+                problem_parameters, is_value_only=True
+            )
+
+        old_evals = {}
+        max_epoch = -1
+        stored_random_seed = None
+        if file_path is not None and os.path.isfile(file_path):
+            from dmosopt_tpu.storage import init_from_h5
+
+            (
+                stored_random_seed,
+                max_epoch,
+                old_evals,
+                param_space,
+                objective_names,
+                feature_dtypes,
+                constraint_names,
+                problem_parameters,
+                problem_ids,
+            ) = init_from_h5(
+                file_path,
+                param_space.parameter_names if param_space is not None else None,
+                opt_id,
+                self.logger,
+            )
+        if stored_random_seed is not None:
+            if local_random is not None:
+                self.logger.warning("Using saved random seed to create local RNG. ")
+            self.local_random = np.random.default_rng(seed=stored_random_seed)
+        if self.local_random is None:
+            self.local_random = as_generator(random_seed)
+
+        if problem_parameters is not None and param_space is not None:
+            assert set(param_space.parameter_names).isdisjoint(
+                set(problem_parameters.parameter_names)
+            )
+
+        assert param_space is not None and param_space.n_parameters > 0
+        self.param_space = param_space
+        self.param_names = param_space.parameter_names
+
+        assert objective_names is not None
+        self.objective_names = objective_names
+
+        has_problem_ids = problem_ids is not None
+        if not has_problem_ids:
+            problem_ids = set([0])
+
+        self.n_initial = n_initial
+        self.initial_maxiter = initial_maxiter
+        self.initial_method = initial_method
+        self.problem_parameters = problem_parameters
+        self.file_path, self.save = file_path, save
+
+        for okw in self.optimizer_kwargs:
+            if okw is None:
+                continue
+            di_crossover = okw.get("di_crossover", None)
+            if isinstance(di_crossover, dict):
+                okw["di_crossover"] = param_space.flatten(di_crossover)
+            di_mutation = okw.get("di_mutation", None)
+            if isinstance(di_mutation, dict):
+                okw["di_mutation"] = param_space.flatten(di_mutation)
+
+        self.epoch_count = 0
+        self.start_epoch = 0
+        if max_epoch > 0:
+            self.start_epoch = max_epoch
+
+        self.n_epochs = n_epochs
+        self.save_eval = save_eval
+        self.save_surrogate_evals_ = save_surrogate_evals
+        self.save_optimizer_params_ = save_optimizer_params
+        self.saved_eval_count = 0
+        self.eval_count = 0
+
+        self.obj_fun_args = obj_fun_args
+        self.jax_objective = jax_objective
+        if has_problem_ids:
+            self.eval_fun = partial(
+                eval_obj_fun_mp,
+                obj_fun,
+                self.problem_parameters,
+                self.param_space,
+                nested_parameter_space,
+                self.obj_fun_args,
+                problem_ids,
+            )
+        else:
+            self.eval_fun = partial(
+                eval_obj_fun_sp,
+                obj_fun,
+                self.problem_parameters,
+                self.param_space,
+                nested_parameter_space,
+                self.obj_fun_args,
+                0,
+            )
+
+        self.reduce_fun = reduce_fun
+        self.reduce_fun_args = reduce_fun_args
+
+        self.old_evals = old_evals
+        self.has_problem_ids = has_problem_ids
+        self.problem_ids = problem_ids
+
+        self.optimizer_dict = {}
+        self.storage_dict = {}
+
+        self.feature_constructor = lambda x: x
+        if feature_class is not None:
+            self.feature_constructor = import_object_by_path(feature_class)
+        self.feature_dtypes = feature_dtypes
+        self.feature_names = None
+        if feature_dtypes is not None:
+            self.feature_names = [dt[0] for dt in feature_dtypes]
+        self.constraint_names = constraint_names
+
+        # evaluation backend (the distwq replacement)
+        if evaluator is not None:
+            self.evaluator = evaluator
+        elif jax_objective:
+            self.evaluator = JaxBatchEvaluator(
+                obj_fun,
+                problem_ids=sorted(problem_ids),
+                mesh=mesh,
+                has_features=feature_dtypes is not None,
+                has_constraints=constraint_names is not None,
+            )
+        else:
+            self.evaluator = HostFunEvaluator(
+                self.eval_fun, n_workers=n_eval_workers
+            )
+
+        if self.save and file_path is not None and not os.path.isfile(file_path):
+            from dmosopt_tpu.storage import init_h5
+
+            init_h5(
+                self.opt_id,
+                self.problem_ids,
+                self.has_problem_ids,
+                self.param_space,
+                self.param_names,
+                self.objective_names,
+                self.feature_dtypes,
+                self.constraint_names,
+                self.problem_parameters,
+                self.metadata,
+                self.random_seed,
+                self.file_path,
+                surrogate_mean_variance=self.optimize_mean_variance,
+            )
+
+        self.stats = {}
+
+    # -------------------------------------------------------------- stats
+
+    def get_stats(self):
+        for problem_id in self.problem_ids:
+            if problem_id in self.optimizer_dict:
+                self.stats.update(
+                    {
+                        f"{problem_id}_{k}" if problem_id > 0 else k: v
+                        for k, v in self.optimizer_dict[problem_id].stats.items()
+                    }
+                )
+        result = {}
+        for key in self.stats:
+            if not key.endswith("_start") and not key.endswith("_end"):
+                result[key] = self.stats[key]
+                continue
+            name, period = key.rsplit("_", 1)
+            if period == "start" and f"{name}_end" in self.stats:
+                result[name] = self.stats[f"{name}_end"] - self.stats[key]
+        return result
+
+    # ----------------------------------------------------- strategy setup
+
+    def initialize_strategy(self):
+        opt_prob = OptProblem(
+            self.param_names,
+            self.objective_names,
+            self.feature_dtypes,
+            self.feature_constructor,
+            self.constraint_names,
+            self.param_space,
+            self.eval_fun,
+            logger=self.logger,
+        )
+        dim = len(self.param_names)
+        initial = None
+        for problem_id in self.problem_ids:
+            initial = None
+            if problem_id in self.old_evals and len(self.old_evals[problem_id]) > 0:
+                evals = self.old_evals[problem_id]
+                old_eval_epochs = [e.epoch for e in evals]
+                epochs = None
+                if len(old_eval_epochs) > 0 and old_eval_epochs[0] is not None:
+                    epochs = np.concatenate(old_eval_epochs, axis=None)
+                x = np.vstack([e.parameters for e in evals])
+                y = np.vstack([e.objectives for e in evals])
+                f = None
+                if self.feature_dtypes is not None:
+                    e0 = evals[0]
+                    f_shape = (
+                        e0.features.shape[0] if len(e0.features.shape) > 0 else 0
+                    )
+                    if f_shape == 0:
+                        old_eval_fs = [[e.features] for e in evals]
+                    elif f_shape == 1:
+                        old_eval_fs = [e.features for e in evals]
+                    else:
+                        old_eval_fs = [
+                            e.features.reshape((1, f_shape)) for e in evals
+                        ]
+                    f = self.feature_constructor(
+                        np.concatenate(old_eval_fs, axis=0)
+                    )
+                c = None
+                if self.constraint_names is not None:
+                    c = np.vstack([e.constraints for e in evals])
+                initial = (epochs, x, y, f, c)
+                if len(x) >= self.n_initial * dim:
+                    self.start_epoch += 1
+
+            self.optimizer_dict[problem_id] = DistOptStrategy(
+                opt_prob,
+                self.n_initial,
+                initial=initial,
+                resample_fraction=self.resample_fraction,
+                population_size=self.population_size,
+                num_generations=self.num_generations,
+                initial_maxiter=self.initial_maxiter,
+                initial_method=self.initial_method,
+                distance_metric=self.distance_metric,
+                surrogate_method_name=self.surrogate_method_name,
+                surrogate_method_kwargs=self.surrogate_method_kwargs,
+                surrogate_custom_training=self.surrogate_custom_training,
+                surrogate_custom_training_kwargs=self.surrogate_custom_training_kwargs,
+                sensitivity_method_name=self.sensitivity_method_name,
+                sensitivity_method_kwargs=self.sensitivity_method_kwargs,
+                optimizer_name=self.optimizer_name,
+                optimizer_kwargs=self.optimizer_kwargs,
+                feasibility_method_name=self.feasibility_method_name,
+                feasibility_method_kwargs=self.feasibility_method_kwargs,
+                termination_conditions=self.termination_conditions,
+                optimize_mean_variance=self.optimize_mean_variance,
+                local_random=self.local_random,
+                logger=self.logger,
+                file_path=self.file_path,
+            )
+            self.storage_dict[problem_id] = []
+        if initial is not None:
+            self.print_best()
+
+    # -------------------------------------------------------- persistence
+
+    def save_evals(self):
+        """Store results of finished evals to file
+        (reference dmosopt.py:962-1015)."""
+        from dmosopt_tpu.storage import save_to_h5
+
+        finished_evals = {}
+        n = len(self.objective_names)
+        n_pred = 2 * n if self.optimize_mean_variance else n
+        for problem_id in self.problem_ids:
+            storage_evals = self.storage_dict[problem_id]
+            if len(storage_evals) > 0:
+                finished_evals[problem_id] = (
+                    [e.epoch for e in storage_evals],
+                    [e.parameters for e in storage_evals],
+                    [e.objectives for e in storage_evals],
+                    [e.features for e in storage_evals]
+                    if self.feature_names is not None
+                    else None,
+                    [e.constraints for e in storage_evals]
+                    if self.constraint_names is not None
+                    else None,
+                    [
+                        [np.nan] * n_pred if e.prediction is None else e.prediction
+                        for e in storage_evals
+                    ],
+                )
+                self.storage_dict[problem_id] = []
+
+        if len(finished_evals) > 0:
+            save_to_h5(
+                self.opt_id,
+                self.problem_ids,
+                self.has_problem_ids,
+                self.objective_names,
+                self.feature_dtypes,
+                self.constraint_names,
+                self.param_space,
+                finished_evals,
+                self.problem_parameters,
+                self.metadata,
+                self.random_seed,
+                self.file_path,
+                self.logger,
+                surrogate_mean_variance=self.optimize_mean_variance,
+            )
+
+    def save_surrogate_evals(self, problem_id, epoch, gen_index, x_sm, y_sm):
+        if x_sm.shape[0] > 0:
+            from dmosopt_tpu.storage import save_surrogate_evals_to_h5
+
+            save_surrogate_evals_to_h5(
+                self.opt_id,
+                problem_id,
+                self.param_names,
+                self.objective_names,
+                epoch,
+                gen_index,
+                x_sm,
+                y_sm,
+                self.file_path,
+                self.logger,
+            )
+
+    def save_optimizer_params(self, problem_id, epoch, optimizer_name, optimizer_params):
+        from dmosopt_tpu.storage import save_optimizer_params_to_h5
+
+        save_optimizer_params_to_h5(
+            self.opt_id,
+            problem_id,
+            epoch,
+            optimizer_name,
+            optimizer_params,
+            self.file_path,
+            self.logger,
+        )
+
+    def save_stats(self, problem_id, epoch):
+        from dmosopt_tpu.storage import save_stats_to_h5
+
+        save_stats_to_h5(
+            self.opt_id, problem_id, epoch, self.file_path, self.logger,
+            self.get_stats(),
+        )
+
+    # ------------------------------------------------------------ queries
+
+    def get_best(self, feasible=True, return_features=False, return_constraints=False):
+        best_results = {}
+        for problem_id in self.problem_ids:
+            best_x, best_y, best_f, best_c = self.optimizer_dict[
+                problem_id
+            ].get_best_evals(feasible=feasible)
+            prms = list(zip(self.param_names, list(best_x.T)))
+            lres = list(zip(self.objective_names, list(best_y.T)))
+            lconstr = None
+            if self.constraint_names is not None and best_c is not None:
+                lconstr = list(zip(self.constraint_names, list(best_c.T)))
+            if return_features and return_constraints:
+                best_results[problem_id] = (prms, lres, best_f, lconstr)
+            elif return_features:
+                best_results[problem_id] = (prms, lres, best_f)
+            elif return_constraints:
+                best_results[problem_id] = (prms, lres, lconstr)
+            else:
+                best_results[problem_id] = (prms, lres)
+        return best_results if self.has_problem_ids else best_results[0]
+
+    def print_best(self, feasible=True):
+        best_results = self.get_best(
+            feasible=feasible, return_features=True, return_constraints=True
+        )
+        items = (
+            best_results.items()
+            if self.has_problem_ids
+            else [(0, best_results)]
+        )
+        for problem_id, (prms, res, ftrs, constr) in items:
+            prms_dict = dict(prms)
+            res_dict = dict(res)
+            constr_dict = dict(constr) if constr is not None else None
+            n_res = next(iter(res_dict.values())).shape[0]
+            for i in range(n_res):
+                res_i = {k: res_dict[k][i] for k in res_dict}
+                prms_i = {k: prms_dict[k][i] for k in prms_dict}
+                parts = [f"Best eval {i} so far"]
+                if self.has_problem_ids:
+                    parts.append(f"for id {problem_id}")
+                msg = f"{' '.join(parts)}: {res_i}@{prms_i}"
+                if ftrs is not None:
+                    msg += f" [{ftrs[i]}]"
+                if constr_dict is not None:
+                    msg += f" [constr: {({k: constr_dict[k][i] for k in constr_dict})}]"
+                self.logger.info(msg)
+
+    # ---------------------------------------------------------- epoch loop
+
+    def _time_exceeded(self) -> bool:
+        return (
+            self.time_limit is not None
+            and (time.time() - self.start_time) >= self.time_limit
+        )
+
+    def _process_requests(self):
+        """Drain all pending evaluation requests through the evaluation
+        backend. Replaces the reference's MPI submit/probe polling loop
+        (dmosopt.py:1152-1339) with batched synchronous evaluation: each
+        round gathers one request per problem id (so multi-problem tasks
+        share an evaluation call, matching eval_obj_fun_mp), batches all
+        rounds, and evaluates them in one backend call."""
+        has_requests = any(
+            self.optimizer_dict[pid].has_requests() for pid in self.problem_ids
+        )
+
+        while has_requests and not self._time_exceeded():
+            task_args = []
+            task_reqs = []
+            while True:
+                eval_req_dict = {}
+                eval_x_dict = {}
+                for problem_id in self.problem_ids:
+                    eval_req = self.optimizer_dict[problem_id].get_next_request()
+                    if eval_req is None:
+                        continue  # this problem's queue is drained
+                    eval_req_dict[problem_id] = eval_req
+                    eval_x_dict[problem_id] = eval_req.parameters
+                if not eval_req_dict:
+                    break
+                # partial rounds are allowed: per-problem queues can have
+                # unequal lengths (e.g. resample dedupe dropped different
+                # counts), and the evaluation wrappers iterate only the
+                # problems present in the submitted dict
+                task_args.append(eval_x_dict)
+                task_reqs.append(eval_req_dict)
+
+            if not task_args:
+                break
+
+            results = self.evaluator.evaluate_batch(task_args)
+
+            for res, eval_req_dict in zip(results, task_reqs):
+                if self.reduce_fun is not None:
+                    res = (
+                        self.reduce_fun(res)
+                        if self.reduce_fun_args is None
+                        else self.reduce_fun(res, *self.reduce_fun_args)
+                    )
+                t = res.pop("time", -1.0) if isinstance(res, dict) else -1.0
+                for problem_id, rres in res.items():
+                    eval_req = eval_req_dict[problem_id]
+                    kwargs = {}
+                    if (
+                        self.feature_names is not None
+                        and self.constraint_names is not None
+                    ):
+                        y, kwargs["f"], kwargs["c"] = rres[0], rres[1], rres[2]
+                    elif self.feature_names is not None:
+                        y, kwargs["f"] = rres[0], rres[1]
+                    elif self.constraint_names is not None:
+                        y, kwargs["c"] = rres[0], rres[1]
+                    else:
+                        y = rres
+                    entry = self.optimizer_dict[problem_id].complete_request(
+                        eval_req.parameters,
+                        np.asarray(y),
+                        pred=eval_req.prediction,
+                        epoch=eval_req.epoch,
+                        time=t,
+                        **kwargs,
+                    )
+                    self.storage_dict[problem_id].append(entry)
+                    if self.verbose:
+                        prms = list(zip(self.param_names, list(eval_req.parameters.T)))
+                        lres = list(zip(self.objective_names, np.asarray(y).T))
+                        self.logger.info(
+                            f"problem id {problem_id}: optimization epoch "
+                            f"{eval_req.epoch}: parameters {prms}: {lres}"
+                        )
+                self.eval_count += 1
+
+            if (
+                self.save
+                and (self.eval_count - self.saved_eval_count) >= self.save_eval
+            ):
+                self.save_evals()
+                self.saved_eval_count = self.eval_count
+
+            has_requests = any(
+                self.optimizer_dict[pid].has_requests() for pid in self.problem_ids
+            )
+
+        if self.save and self.saved_eval_count < self.eval_count:
+            self.save_evals()
+            self.saved_eval_count = self.eval_count
+
+        return self.eval_count, self.saved_eval_count
+
+    def run_epoch(self, completed_epoch: bool = False):
+        """One full epoch: drain initial requests, run per-problem epoch
+        state machines to completion (reference dmosopt.py:1341-1470)."""
+        epoch = self.epoch_count + self.start_epoch
+        advance_epoch = self.epoch_count < self.n_epochs - 1
+
+        self.stats["init_sampling_start"] = time.time()
+        self._process_requests()
+
+        for problem_id in self.problem_ids:
+            distopt = self.optimizer_dict[problem_id]
+
+            if self.dynamic_initial_sampling is not None and self.epoch_count == 0:
+                dynamic_initial_sampler = import_object_by_path(
+                    self.dynamic_initial_sampling
+                )
+                dyn_sample_iter_count = 0
+                while True:
+                    more_samples = dynamic_initial_sampler(
+                        file_path=self.file_path,
+                        iteration=dyn_sample_iter_count,
+                        evaluated_samples=distopt.completed,
+                        next_samples=opt.xinit(
+                            self.n_initial,
+                            distopt.prob.param_names,
+                            distopt.prob.lb,
+                            distopt.prob.ub,
+                            nPrevious=None,
+                            maxiter=self.initial_maxiter,
+                            method=self.initial_method,
+                            local_random=self.local_random,
+                            logger=self.logger,
+                        ),
+                        sampler={
+                            "n_initial": self.n_initial,
+                            "maxiter": self.initial_maxiter,
+                            "method": self.initial_method,
+                            "param_names": distopt.prob.param_names,
+                            "xlb": distopt.prob.lb,
+                            "xub": distopt.prob.ub,
+                        },
+                        **(self.dynamic_initial_sampling_kwargs or {}),
+                    )
+                    if more_samples is None:
+                        break
+                    for i in range(more_samples.shape[0]):
+                        distopt.append_request(
+                            EvalRequest(more_samples[i, :], None, 0)
+                        )
+                    self._process_requests()
+                    dyn_sample_iter_count += 1
+
+            distopt.initialize_epoch(epoch)
+
+        self.stats["init_sampling_end"] = time.time()
+
+        while not completed_epoch:
+            if self._time_exceeded():
+                # soft stop (reference dmosopt.py:1165-1168): pending
+                # requests are abandoned; state saved so far is kept
+                self.logger.warning("time limit exceeded; stopping epoch")
+                break
+            self._process_requests()
+
+            for problem_id in self.problem_ids:
+                strategy_state, strategy_value, completed_evals = self.optimizer_dict[
+                    problem_id
+                ].update_epoch(resample=advance_epoch)
+                completed_epoch = strategy_state == StrategyState.CompletedEpoch
+                if not completed_epoch:
+                    continue
+                res = strategy_value
+
+                # prediction accuracy of completed evaluations
+                # (reference dmosopt.py:1420-1449)
+                if (completed_evals is not None) and (epoch > 1):
+                    x_completed, y_completed, pred_completed = (
+                        completed_evals[0],
+                        completed_evals[1],
+                        completed_evals[2],
+                    )
+                    c_completed = completed_evals[4]
+                    if c_completed is not None:
+                        feasible = np.argwhere(
+                            np.all(c_completed > 0.0, axis=1)
+                        ).ravel()
+                        if len(feasible) > 0:
+                            x_completed = x_completed[feasible, :]
+                            y_completed = y_completed[feasible, :]
+                            pred_completed = pred_completed[feasible, :]
+                    if x_completed.shape[0] > 0:
+                        mae = []
+                        for i in range(y_completed.shape[1]):
+                            y_i = y_completed[:, i]
+                            pred_i = pred_completed[:, i]
+                            valid = ~np.isnan(y_i) & ~np.isnan(pred_i)
+                            mae.append(
+                                float(np.mean(np.abs(y_i[valid] - pred_i[valid])))
+                                if valid.any()
+                                else np.nan
+                            )
+                        self.logger.info(
+                            f"surrogate accuracy at epoch {epoch - 1} for "
+                            f"problem {problem_id} was {mae}"
+                        )
+
+                if advance_epoch and epoch > 0:
+                    if self.save and self.save_surrogate_evals_:
+                        self.save_surrogate_evals(
+                            problem_id, epoch, res.gen_index, res.x, res.y
+                        )
+                    if self.save and self.save_optimizer_params_:
+                        optimizer = res.optimizer
+                        self.save_optimizer_params(
+                            problem_id,
+                            epoch,
+                            optimizer.name,
+                            optimizer.opt_parameters,
+                        )
+
+        if self.save:
+            for problem_id in self.problem_ids:
+                self.save_stats(problem_id, epoch)
+
+        self.epoch_count += 1
+        return self.epoch_count
+
+
+# -------------------------------------------------------------------- run
+
+
+def dopt_init(dopt_params, verbose=False, initialize_strategy=False):
+    """Build a DistOptimizer from a parameter dict, importing the objective
+    by path when given as `obj_fun_name` / `obj_fun_init_name`
+    (reference: dmosopt/dmosopt.py:2416-2465)."""
+    dopt_params = dict(dopt_params)
+    objfun = dopt_params.pop("obj_fun", None)
+    if objfun is None:
+        objfun_name = dopt_params.pop("obj_fun_name", None)
+        if objfun_name is not None:
+            objfun = import_object_by_path(objfun_name)
+        else:
+            objfun_init_name = dopt_params.pop("obj_fun_init_name", None)
+            objfun_init_args = dopt_params.pop("obj_fun_init_args", None) or {}
+            if objfun_init_name is None:
+                raise RuntimeError("dmosopt_tpu.dopt_init: objfun is not provided")
+            objfun_init = import_object_by_path(objfun_init_name)
+            objfun = objfun_init(**objfun_init_args, worker=None)
+    else:
+        dopt_params.pop("obj_fun_name", None)
+    dopt_params["obj_fun"] = objfun
+
+    reducefun_name = dopt_params.pop("reduce_fun_name", None)
+    if reducefun_name is not None:
+        dopt_params["reduce_fun"] = import_object_by_path(reducefun_name)
+
+    ctrl_init_fun_name = dopt_params.pop("controller_init_fun_name", None)
+    ctrl_init_fun_args = dopt_params.pop("controller_init_fun_args", {})
+    if ctrl_init_fun_name is not None:
+        import_object_by_path(ctrl_init_fun_name)(**ctrl_init_fun_args)
+
+    dopt = DistOptimizer(**dopt_params, verbose=verbose)
+    if initialize_strategy:
+        dopt.initialize_strategy()
+    dopt_dict[dopt.opt_id] = dopt
+    return dopt
+
+
+def run(
+    dopt_params,
+    time_limit=None,
+    feasible=True,
+    return_features=False,
+    return_constraints=False,
+    verbose=True,
+    **kwargs,
+):
+    """Run a complete MO-ASMO optimization (reference:
+    dmosopt/dmosopt.py:2501-2571). Single-process, TPU-backed: no MPI
+    roles; the evaluation backend handles batching/sharding. Legacy
+    distwq-specific kwargs (spawn_workers, nprocs_per_worker, ...) are
+    accepted and ignored."""
+    if time_limit is not None:
+        dopt_params = dict(dopt_params)
+        dopt_params["time_limit"] = time_limit
+    dopt = dopt_init(dopt_params, verbose=verbose, initialize_strategy=True)
+    logger = dopt.logger
+    logger.info(f"Optimizing for {dopt.n_epochs} epochs...")
+    if dopt.n_epochs <= 0:
+        dopt.run_epoch(completed_epoch=True)
+    else:
+        while dopt.epoch_count < dopt.n_epochs and not dopt._time_exceeded():
+            dopt.run_epoch()
+    dopt.print_best()
+    return dopt.get_best(
+        feasible=feasible,
+        return_features=return_features,
+        return_constraints=return_constraints,
+    )

@@ -1,0 +1,422 @@
+"""HDF5 persistence: append-oriented checkpoint / resume / analysis store.
+
+Capability match: reference `dmosopt/dmosopt.py:1474-2324` — one group per
+`opt_id`, per-problem append-only eval logs, surrogate-eval logs,
+per-epoch optimizer params and stats, stored random seed, and
+`init_from_h5` restart that reconstructs old evaluations and the
+parameter space.
+
+Schema redesign (same layout, simpler types): the reference stores
+parameter specs and problem parameters in hand-built compound/enum HDF5
+dtypes (`h5_init_types`, dmosopt.py:1585-1790). Here structured metadata
+(parameter specs with nested paths, problem parameters, feature dtypes,
+user metadata) is serialized as JSON attributes — robust, introspectable
+with any HDF5 tool, and byte-layout-independent — while numeric eval logs
+remain resizable float64 datasets for append-only writes
+(`h5_concat_dataset` semantics, dmosopt.py:1492).
+
+Layout:
+    /{opt_id}/random_seed, problem_ids, metadata(json), parameter_space(json),
+              problem_parameters(json), objective_names(json),
+              feature_dtypes(json), constraint_names(json)
+    /{opt_id}/{problem_id}/epochs        (N,)      uint32
+    /{opt_id}/{problem_id}/parameters    (N, n)    float64
+    /{opt_id}/{problem_id}/objectives    (N, d)    float64
+    /{opt_id}/{problem_id}/features      (N, ...)  float64   [optional]
+    /{opt_id}/{problem_id}/constraints   (N, m)    float64   [optional]
+    /{opt_id}/{problem_id}/predictions   (N, d|2d) float64
+    /{opt_id}/{problem_id}/surrogate_evals/{epoch}/{gen_index,x,y}
+    /{opt_id}/{problem_id}/optimizer_params/{epoch}  (json attrs)
+    /{opt_id}/{problem_id}/optimizer_stats/{epoch}   (json attrs)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dmosopt_tpu.datatypes import (
+    EvalEntry,
+    ParameterDefn,
+    ParameterSpace,
+    ParameterValue,
+)
+
+
+def _require_h5py():
+    try:
+        import h5py
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "h5py is required for HDF5 persistence but is not installed"
+        ) from e
+    return h5py
+
+
+def h5_get_group(h, groupname):
+    return h[groupname] if groupname in h.keys() else h.create_group(groupname)
+
+
+def h5_get_dataset(g, dsetname, **kwargs):
+    if dsetname in g.keys():
+        return g[dsetname]
+    kwargs["maxshape"] = (None,) + tuple(kwargs.get("shape", (0,)))[1:]
+    return g.create_dataset(dsetname, **kwargs)
+
+
+def h5_concat_dataset(dset, data):
+    """Append rows to a resizable dataset
+    (reference: dmosopt/dmosopt.py:1492-1498)."""
+    dsize = dset.shape[0]
+    newshape = (dsize + data.shape[0],) + dset.shape[1:]
+    dset.resize(newshape)
+    dset[dsize:] = data
+    return dset
+
+
+# ----------------------------------------------------- space serialization
+
+
+def _space_to_json(space: Optional[ParameterSpace]) -> str:
+    if space is None:
+        return json.dumps(None)
+
+    items = []
+    for leaf in space.items:
+        if isinstance(leaf, ParameterDefn):
+            items.append(
+                {
+                    "name": leaf.name,
+                    "lower": leaf.lower,
+                    "upper": leaf.upper,
+                    "is_integer": bool(leaf.is_integer),
+                }
+            )
+        else:
+            items.append(
+                {
+                    "name": leaf.name,
+                    "value": leaf.value,
+                    "is_integer": bool(leaf.is_integer),
+                }
+            )
+    return json.dumps(items)
+
+
+def _space_from_json(s: str, is_value_only: bool = False) -> Optional[ParameterSpace]:
+    items = json.loads(s)
+    if items is None:
+        return None
+    config: Dict = {}
+    for item in items:
+        path = item["name"].split(".")
+        cur = config
+        for key in path[:-1]:
+            cur = cur.setdefault(key, {})
+        if "value" in item:
+            cur[path[-1]] = item["value"]
+        else:
+            cur[path[-1]] = [item["lower"], item["upper"], item["is_integer"]]
+    return ParameterSpace.from_dict(config, is_value_only=is_value_only)
+
+
+def _json_attr(grp, name, value):
+    grp.attrs[name] = json.dumps(value)
+
+
+def _load_json_attr(grp, name, default=None):
+    if name in grp.attrs:
+        return json.loads(grp.attrs[name])
+    return default
+
+
+# ------------------------------------------------------------------- init
+
+
+def init_h5(
+    opt_id,
+    problem_ids,
+    has_problem_ids,
+    spec: ParameterSpace,
+    param_names,
+    objective_names,
+    feature_dtypes,
+    constraint_names,
+    problem_parameters: Optional[ParameterSpace],
+    metadata,
+    random_seed,
+    fpath,
+    surrogate_mean_variance: bool = False,
+):
+    """Initialize the store (reference: dmosopt/dmosopt.py:2285-2324)."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        opt_grp = h5_get_group(h5, opt_id)
+        if random_seed is not None:
+            opt_grp["random_seed"] = random_seed
+        opt_grp["problem_ids"] = np.asarray(sorted(problem_ids), dtype=np.int64)
+        opt_grp.attrs["has_problem_ids"] = bool(has_problem_ids)
+        opt_grp.attrs["surrogate_mean_variance"] = bool(surrogate_mean_variance)
+        _json_attr(opt_grp, "metadata", metadata)
+        opt_grp.attrs["parameter_space"] = _space_to_json(spec)
+        opt_grp.attrs["problem_parameters"] = _space_to_json(problem_parameters)
+        _json_attr(opt_grp, "parameter_names", list(param_names))
+        _json_attr(opt_grp, "objective_names", list(objective_names))
+        _json_attr(
+            opt_grp,
+            "feature_dtypes",
+            [[dt[0], str(dt[1])] for dt in feature_dtypes]
+            if feature_dtypes is not None
+            else None,
+        )
+        _json_attr(
+            opt_grp,
+            "constraint_names",
+            list(constraint_names) if constraint_names is not None else None,
+        )
+
+
+# ------------------------------------------------------------------ write
+
+
+def save_to_h5(
+    opt_id,
+    problem_ids,
+    has_problem_ids,
+    objective_names,
+    feature_dtypes,
+    constraint_names,
+    spec,
+    evals: Dict,
+    problem_parameters,
+    metadata,
+    random_seed,
+    fpath,
+    logger=None,
+    surrogate_mean_variance: bool = False,
+):
+    """Append finished evaluations (reference: dmosopt/dmosopt.py:2026-2153)."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        opt_grp = h5_get_group(h5, opt_id)
+        for problem_id in problem_ids:
+            if problem_id not in evals:
+                continue
+            (
+                epochs_completed,
+                x_completed,
+                y_completed,
+                f_completed,
+                c_completed,
+                pred_completed,
+            ) = evals[problem_id]
+            if len(x_completed) == 0:
+                continue
+            grp = h5_get_group(opt_grp, str(problem_id))
+
+            epochs = np.asarray(epochs_completed, dtype=np.uint32)
+            X = np.vstack([np.asarray(x, dtype=np.float64) for x in x_completed])
+            Y = np.vstack([np.asarray(y, dtype=np.float64) for y in y_completed])
+            P = np.vstack(
+                [np.asarray(p, dtype=np.float64).ravel() for p in pred_completed]
+            )
+
+            dset = h5_get_dataset(
+                grp, "epochs", dtype=np.uint32, shape=(0,)
+            )
+            h5_concat_dataset(dset, epochs)
+            dset = h5_get_dataset(
+                grp, "parameters", dtype=np.float64, shape=(0, X.shape[1])
+            )
+            h5_concat_dataset(dset, X)
+            dset = h5_get_dataset(
+                grp, "objectives", dtype=np.float64, shape=(0, Y.shape[1])
+            )
+            h5_concat_dataset(dset, Y)
+            dset = h5_get_dataset(
+                grp, "predictions", dtype=np.float64, shape=(0, P.shape[1])
+            )
+            h5_concat_dataset(dset, P)
+
+            if f_completed is not None:
+                F = np.vstack(
+                    [
+                        np.asarray(f, dtype=np.float64).reshape(1, -1)
+                        for f in f_completed
+                    ]
+                )
+                dset = h5_get_dataset(
+                    grp, "features", dtype=np.float64, shape=(0, F.shape[1])
+                )
+                h5_concat_dataset(dset, F)
+            if c_completed is not None:
+                C = np.vstack(
+                    [np.asarray(c, dtype=np.float64).reshape(1, -1) for c in c_completed]
+                )
+                dset = h5_get_dataset(
+                    grp, "constraints", dtype=np.float64, shape=(0, C.shape[1])
+                )
+                h5_concat_dataset(dset, C)
+    if logger is not None:
+        logger.info(f"saved evals to {fpath}")
+
+
+def save_surrogate_evals_to_h5(
+    opt_id,
+    problem_id,
+    param_names,
+    objective_names,
+    epoch,
+    gen_index,
+    x_sm,
+    y_sm,
+    fpath,
+    logger=None,
+):
+    """Append surrogate-eval trajectories
+    (reference: dmosopt/dmosopt.py:2189-2240)."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        grp = h5_get_group(
+            h5, f"{opt_id}/{problem_id}/surrogate_evals/{int(epoch)}"
+        )
+        grp["gen_index"] = np.asarray(gen_index, dtype=np.uint32)
+        grp["x"] = np.asarray(x_sm, dtype=np.float64)
+        grp["y"] = np.asarray(y_sm, dtype=np.float64)
+
+
+def save_optimizer_params_to_h5(
+    opt_id, problem_id, epoch, optimizer_name, optimizer_params, fpath, logger=None
+):
+    """Store optimizer hyperparameters per epoch
+    (reference: dmosopt/dmosopt.py:2156-2186)."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        grp = h5_get_group(
+            h5, f"{opt_id}/{problem_id}/optimizer_params/{int(epoch)}"
+        )
+        grp.attrs["optimizer_name"] = str(optimizer_name)
+        for k, v in (optimizer_params or {}).items():
+            try:
+                grp.attrs[k] = (
+                    v.tolist() if isinstance(v, (np.ndarray, list, tuple)) else v
+                )
+            except TypeError:
+                grp.attrs[k] = str(v)
+
+
+def save_stats_to_h5(opt_id, problem_id, epoch, fpath, logger=None, stats=None):
+    """Store runtime stats per epoch (reference: dmosopt/dmosopt.py:2243-2282)."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        grp = h5_get_group(
+            h5, f"{opt_id}/{problem_id}/optimizer_stats/{int(epoch)}"
+        )
+        for k, v in (stats or {}).items():
+            try:
+                grp.attrs[k] = v
+            except TypeError:
+                grp.attrs[k] = str(v)
+
+
+# ------------------------------------------------------------------- read
+
+
+def h5_load_raw(fpath, opt_id):
+    """Load everything stored for `opt_id`
+    (reference: dmosopt/dmosopt.py:1793-1928)."""
+    h5py = _require_h5py()
+    out = {}
+    with h5py.File(fpath, "r") as h5:
+        opt_grp = h5[opt_id]
+        out["random_seed"] = (
+            int(opt_grp["random_seed"][()]) if "random_seed" in opt_grp else None
+        )
+        out["problem_ids"] = (
+            set(int(i) for i in opt_grp["problem_ids"][:])
+            if "problem_ids" in opt_grp
+            else {0}
+        )
+        out["has_problem_ids"] = bool(opt_grp.attrs.get("has_problem_ids", False))
+        out["metadata"] = _load_json_attr(opt_grp, "metadata")
+        out["parameter_space"] = _space_from_json(
+            opt_grp.attrs["parameter_space"]
+        )
+        out["problem_parameters"] = _space_from_json(
+            opt_grp.attrs["problem_parameters"], is_value_only=True
+        )
+        out["parameter_names"] = _load_json_attr(opt_grp, "parameter_names")
+        out["objective_names"] = _load_json_attr(opt_grp, "objective_names")
+        fdt = _load_json_attr(opt_grp, "feature_dtypes")
+        out["feature_dtypes"] = (
+            [(name, dtype) for name, dtype in fdt] if fdt is not None else None
+        )
+        out["constraint_names"] = _load_json_attr(opt_grp, "constraint_names")
+
+        evals = {}
+        for problem_id in out["problem_ids"]:
+            key = str(problem_id)
+            if key not in opt_grp or "parameters" not in opt_grp[key]:
+                evals[problem_id] = []
+                continue
+            grp = opt_grp[key]
+            epochs = grp["epochs"][:]
+            X = grp["parameters"][:]
+            Y = grp["objectives"][:]
+            P = grp["predictions"][:] if "predictions" in grp else None
+            F = grp["features"][:] if "features" in grp else None
+            C = grp["constraints"][:] if "constraints" in grp else None
+            entries = []
+            for i in range(X.shape[0]):
+                entries.append(
+                    EvalEntry(
+                        np.asarray([epochs[i]]),
+                        X[i],
+                        Y[i],
+                        F[i] if F is not None else None,
+                        C[i] if C is not None else None,
+                        P[i] if P is not None else None,
+                        -1.0,
+                    )
+                )
+            evals[problem_id] = entries
+        out["evals"] = evals
+    return out
+
+
+def init_from_h5(fpath, param_names, opt_id, logger=None):
+    """Reconstruct driver state from a previous run
+    (reference: dmosopt/dmosopt.py:1979-2023). Returns
+    (random_seed, max_epoch, old_evals, param_space, objective_names,
+     feature_dtypes, constraint_names, problem_parameters, problem_ids)."""
+    raw = h5_load_raw(fpath, opt_id)
+    param_space = raw["parameter_space"]
+
+    if param_names is not None:
+        stored = list(param_space.parameter_names)
+        if list(param_names) != stored:
+            raise RuntimeError(
+                f"init_from_h5: stored parameter names {stored} do not match "
+                f"requested parameter names {list(param_names)}"
+            )
+
+    max_epoch = -1
+    for entries in raw["evals"].values():
+        for e in entries:
+            if e.epoch is not None:
+                max_epoch = max(max_epoch, int(np.max(e.epoch)))
+
+    problem_ids = raw["problem_ids"] if raw["has_problem_ids"] else None
+    return (
+        raw["random_seed"],
+        max_epoch,
+        raw["evals"],
+        param_space,
+        raw["objective_names"],
+        raw["feature_dtypes"],
+        raw["constraint_names"],
+        raw["problem_parameters"],
+        problem_ids,
+    )

@@ -1,0 +1,481 @@
+"""Per-problem optimization strategy: the request-queue state machine.
+
+Semantics follow the reference `DistOptStrategy` (reference:
+dmosopt/dmosopt.py:43-544): it owns the evaluated-points archive
+(x/y/f/c), a queue of pending `EvalRequest`s, and the per-epoch MO-ASMO
+generator, and exposes `initialize_epoch` / `update_epoch` transitions
+returning `StrategyState`.
+
+The TPU difference is invisible at this layer by design: in surrogate
+mode the epoch generator completes in a single `next()` (the whole inner
+EA loop ran on device), so `update_epoch` reaches `CompletedEpoch`
+without intermediate `WaitingRequests` states; in no-surrogate mode the
+per-generation request/complete cycle matches the reference exactly.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections.abc import Iterator, Sequence
+from types import GeneratorType
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from dmosopt_tpu import moasmo as opt
+from dmosopt_tpu.datatypes import (
+    EpochResults,
+    EvalEntry,
+    EvalRequest,
+    OptProblem,
+    StrategyState,
+)
+from dmosopt_tpu.moasmo import get_duplicates
+from dmosopt_tpu.ops import order_mo
+
+import jax.numpy as jnp
+
+
+def anyclose(x, Y, rtol: float = 1e-4, atol: float = 1e-4) -> bool:
+    """True if any row of Y is elementwise-close to x
+    (reference: dmosopt/dmosopt.py:36-40)."""
+    for i in range(Y.shape[0]):
+        if np.allclose(x, Y[i, :], rtol=rtol, atol=atol):
+            return True
+    return False
+
+
+class DistOptStrategy:
+    def __init__(
+        self,
+        prob: OptProblem,
+        n_initial: int = 10,
+        initial=None,
+        initial_maxiter: int = 5,
+        initial_method: str = "slh",
+        population_size: int = 100,
+        resample_fraction: float = 0.25,
+        num_generations: int = 100,
+        surrogate_method_name: Optional[str] = "gpr",
+        surrogate_method_kwargs: Optional[Dict] = None,
+        surrogate_custom_training: Optional[str] = None,
+        surrogate_custom_training_kwargs: Optional[Dict] = None,
+        sensitivity_method_name: Optional[str] = None,
+        sensitivity_method_kwargs: Optional[Dict] = None,
+        distance_metric=None,
+        optimizer_name: Union[str, Sequence] = "nsga2",
+        optimizer_kwargs: Union[Dict, Sequence, None] = None,
+        feasibility_method_name=None,
+        feasibility_method_kwargs: Optional[Dict] = None,
+        termination_conditions=None,
+        optimize_mean_variance: bool = False,
+        local_random=None,
+        logger=None,
+        file_path=None,
+    ):
+        self.local_random = local_random
+        self.logger = logger
+        self.file_path = file_path
+        self.feasibility_method_name = feasibility_method_name
+        self.feasibility_method_kwargs = feasibility_method_kwargs or {}
+        self.surrogate_method_name = surrogate_method_name
+        self.surrogate_method_kwargs = surrogate_method_kwargs or {}
+        self.surrogate_custom_training = surrogate_custom_training
+        self.surrogate_custom_training_kwargs = surrogate_custom_training_kwargs
+        self.sensitivity_method_name = sensitivity_method_name
+        self.sensitivity_method_kwargs = sensitivity_method_kwargs or {}
+        self.optimizer_name = (
+            optimizer_name
+            if isinstance(optimizer_name, Sequence)
+            and not isinstance(optimizer_name, str)
+            else (optimizer_name,)
+        )
+        if optimizer_kwargs is None:
+            optimizer_kwargs = {"crossover_prob": 0.9, "mutation_prob": 0.1}
+        self.optimizer_kwargs = (
+            optimizer_kwargs
+            if isinstance(optimizer_kwargs, Sequence)
+            else (optimizer_kwargs,)
+        )
+        self.optimize_mean_variance = optimize_mean_variance
+        self.optimizer_iter = itertools.cycle(range(len(self.optimizer_name)))
+        self.distance_metric = distance_metric
+        self.prob = prob
+        self.completed = []
+        self.t = None
+        if initial is None:
+            self.x = None
+            self.y = None
+            self.f = None
+            self.c = None
+        else:
+            epochs, self.x, self.y, self.f, self.c = initial
+        self.resample_fraction = resample_fraction
+        self.num_generations = num_generations
+        self.population_size = population_size
+
+        self.termination = None
+        if callable(termination_conditions):
+            self.termination = termination_conditions(prob)
+        elif termination_conditions:
+            from dmosopt_tpu.adaptive_termination import create_adaptive_termination
+
+            termination_kwargs = {
+                "strategy": "comprehensive",
+                "n_max_gen": num_generations,
+            }
+            if isinstance(termination_conditions, dict):
+                termination_kwargs.update(termination_conditions)
+            self.termination = create_adaptive_termination(prob, **termination_kwargs)
+
+        nPrevious = None
+        if self.x is not None:
+            nPrevious = self.x.shape[0]
+        xinit = opt.xinit(
+            n_initial,
+            prob.param_names,
+            prob.lb,
+            prob.ub,
+            nPrevious=nPrevious,
+            maxiter=initial_maxiter,
+            method=initial_method,
+            local_random=self.local_random,
+            logger=self.logger,
+        )
+        self.reqs = []
+        if xinit is not None:
+            assert xinit.shape[1] == prob.dim
+            if initial is None:
+                self.reqs = [
+                    EvalRequest(xinit[i, :], None, 0) for i in range(xinit.shape[0])
+                ]
+            else:
+                # resume: skip re-seeded points that were already evaluated
+                self.reqs = filter(
+                    lambda req: not anyclose(req.parameters, self.x),
+                    [EvalRequest(xinit[i, :], None, 0) for i in range(xinit.shape[0])],
+                )
+        self.opt_gen = None
+        self.epoch_index = -1
+        self.stats = {}
+
+    # ------------------------------------------------------- request queue
+
+    def append_request(self, req: EvalRequest):
+        if isinstance(self.reqs, Iterator):
+            self.reqs = list(self.reqs)
+        self.reqs.append(req)
+
+    def has_requests(self) -> bool:
+        if isinstance(self.reqs, Iterator):
+            try:
+                peek = next(self.reqs)
+                self.reqs = itertools.chain([peek], self.reqs)
+                return True
+            except StopIteration:
+                return False
+        return len(self.reqs) > 0
+
+    def get_next_request(self) -> Optional[EvalRequest]:
+        if isinstance(self.reqs, Iterator):
+            try:
+                return next(self.reqs)
+            except StopIteration:
+                return None
+        if self.reqs:
+            return self.reqs.pop(0)
+        return None
+
+    def complete_request(
+        self, x, y, epoch=None, f=None, c=None, pred=None, time=-1.0
+    ) -> EvalEntry:
+        x = np.asarray(x)
+        y = np.asarray(y)
+        assert x.shape[0] == self.prob.dim
+        assert y.shape[0] == self.prob.n_objectives
+        if self.optimize_mean_variance and pred is not None:
+            if pred.shape[0] == self.prob.n_objectives:
+                pred = np.column_stack((pred, np.zeros_like(pred)))
+        if (f is not None) and (np.ndim(f) == 1):
+            f = np.reshape(f, (1, -1))
+        entry = EvalEntry(epoch, x, y, f, c, pred, time)
+        self.completed.append(entry)
+        return entry
+
+    def has_completed(self) -> bool:
+        return len(self.completed) > 0
+
+    # ----------------------------------------------------- archive upkeep
+
+    def _remove_duplicate_evals(self):
+        is_duplicate = get_duplicates(self.x)
+        self.x = self.x[~is_duplicate]
+        self.y = self.y[~is_duplicate]
+        if self.f is not None:
+            self.f = self.f[~is_duplicate]
+        if self.c is not None:
+            self.c = self.c[~is_duplicate]
+
+    def _reduce_evals(self):
+        """Trim the archive to the best `population_size` points
+        (reference dmosopt.py:219-229)."""
+        self._remove_duplicate_evals()
+        perm, _, _ = order_mo(jnp.asarray(self.x), jnp.asarray(self.y))
+        perm = np.asarray(perm)[: self.population_size]
+        self.x = self.x[perm, :]
+        self.y = self.y[perm, :]
+        if self.c is not None:
+            self.c = self.c[perm, :]
+        if self.f is not None:
+            self.f = self.f[perm]
+
+    def _update_evals(self):
+        """Fold completed evaluations into the archive once the request
+        queue is drained (reference dmosopt.py:229-305)."""
+        result = None
+        if len(self.completed) > 0 and not self.has_requests():
+            x_completed = np.vstack([e.parameters for e in self.completed])
+            y_completed = np.vstack([e.objectives for e in self.completed])
+            n_obj_cols = (
+                2 * self.prob.n_objectives
+                if self.optimize_mean_variance
+                else self.prob.n_objectives
+            )
+            y_predicted = np.vstack(
+                [
+                    [np.nan] * n_obj_cols if e.prediction is None else e.prediction
+                    for e in self.completed
+                ]
+            )
+
+            f_completed = None
+            if self.prob.n_features is not None:
+                f_completed = np.concatenate(
+                    [e.features for e in self.completed], axis=0
+                )
+            c_completed = None
+            if self.prob.n_constraints is not None:
+                c_completed = np.vstack([e.constraints for e in self.completed])
+
+            assert x_completed.shape[1] == self.prob.dim
+            assert y_completed.shape[1] == self.prob.n_objectives
+            if self.prob.n_constraints is not None:
+                assert c_completed.shape[1] == self.prob.n_constraints
+
+            if self.x is None:
+                self.x = x_completed
+                self.y = y_completed
+                self.f = f_completed
+                self.c = c_completed
+            else:
+                self.x = np.vstack((self.x, x_completed))
+                self.y = np.vstack((self.y, y_completed))
+                if self.prob.n_features is not None:
+                    self.f = np.concatenate((self.f, f_completed), axis=0)
+                if self.prob.n_constraints is not None:
+                    self.c = np.vstack((self.c, c_completed))
+
+            t_completed = np.vstack([e.time for e in self.completed])
+            self.t = (
+                t_completed if self.t is None else np.vstack((self.t, t_completed))
+            )
+            ts = self.t[self.t > 0.0]
+            if len(ts) > 0:
+                self.stats.update(
+                    {
+                        "eval_min": np.min(ts),
+                        "eval_max": np.max(ts),
+                        "eval_mean": np.mean(ts),
+                        "eval_std": np.std(ts),
+                        "eval_sum": np.sum(ts),
+                        "eval_median": np.median(ts),
+                    }
+                )
+            else:
+                self.stats.update(
+                    {k: -1 for k in (
+                        "eval_min", "eval_max", "eval_mean",
+                        "eval_std", "eval_sum", "eval_median",
+                    )}
+                )
+
+            self._remove_duplicate_evals()
+            self.completed = []
+            result = x_completed, y_completed, y_predicted, f_completed, c_completed
+        return result
+
+    # ------------------------------------------------------- epoch driving
+
+    def initialize_epoch(self, epoch_index: int):
+        assert self.opt_gen is None, (
+            "Optimization generator is active in DistOptStrategy"
+        )
+        optimizer_index = next(self.optimizer_iter)
+        optimizer_kwargs = {}
+        if self.optimizer_kwargs[optimizer_index] is not None:
+            optimizer_kwargs.update(self.optimizer_kwargs[optimizer_index])
+        if self.distance_metric is not None:
+            optimizer_kwargs["distance_metric"] = self.distance_metric
+
+        self._update_evals()
+
+        assert epoch_index > self.epoch_index
+        self.epoch_index = epoch_index
+        self.opt_gen = opt.epoch(
+            self.num_generations,
+            self.prob.param_names,
+            self.prob.objective_names,
+            self.prob.lb,
+            self.prob.ub,
+            self.resample_fraction,
+            self.x,
+            self.y,
+            self.c,
+            pop=self.population_size,
+            optimizer_name=self.optimizer_name[optimizer_index],
+            optimizer_kwargs=optimizer_kwargs,
+            surrogate_method_name=self.surrogate_method_name,
+            surrogate_method_kwargs=self.surrogate_method_kwargs,
+            surrogate_custom_training=self.surrogate_custom_training,
+            surrogate_custom_training_kwargs=self.surrogate_custom_training_kwargs,
+            sensitivity_method_name=self.sensitivity_method_name,
+            sensitivity_method_kwargs=self.sensitivity_method_kwargs,
+            feasibility_method_name=self.feasibility_method_name,
+            feasibility_method_kwargs=self.feasibility_method_kwargs,
+            optimize_mean_variance=self.optimize_mean_variance,
+            termination=self.termination,
+            local_random=self.local_random,
+            logger=self.logger,
+            file_path=self.file_path,
+        )
+
+        item = None
+        try:
+            item = next(self.opt_gen)
+        except StopIteration as ex:
+            self.opt_gen.close()
+            # surrogate mode: epoch completed on-device in one shot; stash
+            # the result dict for update_epoch (reference dmosopt.py:352-358)
+            self.opt_gen = ex.value
+
+        if item is not None:
+            x_gen, reduce_evals = item
+            if reduce_evals:
+                self._reduce_evals()
+            for i in range(x_gen.shape[0]):
+                self.append_request(EvalRequest(x_gen[i, :], None, self.epoch_index))
+
+    def _complete_from_result(self, result_dict, resample: bool):
+        self.stats.update(result_dict.get("stats", {}))
+        if "best_x" in result_dict:
+            return StrategyState.CompletedEpoch, EpochResults(
+                result_dict["best_x"],
+                result_dict["best_y"],
+                result_dict["gen_index"],
+                result_dict["x"],
+                result_dict["y"],
+                result_dict["optimizer"],
+            )
+        x_resample = result_dict["x_resample"]
+        y_pred = result_dict["y_pred"]
+        if resample and x_resample is not None:
+            for i in range(x_resample.shape[0]):
+                self.append_request(
+                    EvalRequest(x_resample[i, :], y_pred[i], self.epoch_index + 1)
+                )
+        return StrategyState.CompletedEpoch, EpochResults(
+            x_resample,
+            y_pred,
+            result_dict["gen_index"],
+            result_dict["x_sm"],
+            result_dict["y_sm"],
+            result_dict["optimizer"],
+        )
+
+    def update_epoch(self, resample: bool = False):
+        """Advance the epoch state machine; returns
+        (StrategyState, value, completed_evals) — reference dmosopt.py:368-504."""
+        assert self.opt_gen is not None, "Epoch not initialized"
+
+        return_state = None
+        return_value = None
+        completed_evals = self._update_evals()
+
+        if completed_evals is None and self.has_requests():
+            return StrategyState.WaitingRequests, None, None
+
+        try:
+            if isinstance(self.opt_gen, dict):
+                result_dict = self.opt_gen
+                self.opt_gen = None
+                return_state, return_value = self._complete_from_result(
+                    result_dict, resample
+                )
+                return return_state, return_value, completed_evals
+            if completed_evals is None:
+                item, reduce_evals = next(self.opt_gen)
+            else:
+                x_gen, y_gen, c_gen = (
+                    completed_evals[0],
+                    completed_evals[1],
+                    completed_evals[4],
+                )
+                item, reduce_evals = self.opt_gen.send((x_gen, y_gen, c_gen))
+        except StopIteration as ex:
+            if isinstance(self.opt_gen, GeneratorType):
+                self.opt_gen.close()
+            self.opt_gen = None
+            return_state, return_value = self._complete_from_result(
+                ex.value, resample
+            )
+        else:
+            if reduce_evals:
+                self._reduce_evals()
+            x_gen = item
+            for i in range(x_gen.shape[0]):
+                self.append_request(EvalRequest(x_gen[i, :], None, self.epoch_index))
+            return_state = StrategyState.EnqueuedRequests
+            return_value = x_gen
+
+        return return_state, return_value, completed_evals
+
+    # ------------------------------------------------------------ queries
+
+    def get_best_evals(self, feasible: bool = True):
+        if self.x is None:
+            return None, None, None, None
+        bestx, besty, bestf, bestc, _, _ = opt.get_best(
+            self.x,
+            self.y,
+            self.f,
+            self.c,
+            self.prob.dim,
+            self.prob.n_objectives,
+            feasible=feasible,
+        )
+        return bestx, besty, self.prob.feature_constructor(bestf), bestc
+
+    def get_evals(self, return_features: bool = False, return_constraints: bool = False):
+        out = [self.x, self.y]
+        if return_features:
+            out.append(self.f)
+        if return_constraints:
+            out.append(self.c)
+        return tuple(out)
+
+    def get_completed(self):
+        if not self.completed:
+            return None
+        x_completed = [e.parameters for e in self.completed]
+        y_completed = [e.objectives for e in self.completed]
+        f_completed = (
+            [e.features for e in self.completed]
+            if self.prob.n_features is not None
+            else None
+        )
+        c_completed = (
+            [e.constraints for e in self.completed]
+            if self.prob.n_constraints is not None
+            else None
+        )
+        return (x_completed, y_completed, f_completed, c_completed)

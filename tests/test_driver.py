@@ -1,0 +1,172 @@
+"""End-to-end driver tests through the public `run()` API, mirroring the
+reference's ZDT oracle pattern (reference: tests/test_zdt1_nsga2_trs.py)."""
+
+import numpy as np
+import pytest
+
+import dmosopt_tpu
+from dmosopt_tpu.benchmarks.zdt import zdt1_pareto, distance_to_front
+
+
+N_DIM = 8
+
+
+def zdt1_obj(pp):
+    """Host-Python objective taking a parameter dict (reference style)."""
+    x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+    f1 = x[0]
+    g = 1.0 + 9.0 / (N_DIM - 1) * np.sum(x[1:])
+    f2 = g * (1.0 - np.sqrt(f1 / g))
+    return np.array([f1, f2])
+
+
+def _space(n=N_DIM):
+    return {f"x{i}": [0.0, 1.0] for i in range(n)}
+
+
+def _base_params(**over):
+    params = {
+        "opt_id": "test_zdt1",
+        "obj_fun": zdt1_obj,
+        "objective_names": ["f1", "f2"],
+        "space": _space(),
+        "problem_parameters": {},
+        "n_initial": 8,
+        "n_epochs": 3,
+        "population_size": 64,
+        "num_generations": 40,
+        "resample_fraction": 0.5,
+        "initial_method": "slh",
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 4, "n_iter": 60, "seed": 0},
+        "random_seed": 42,
+    }
+    params.update(over)
+    return params
+
+
+def test_run_zdt1_moasmo_quality():
+    best = dmosopt_tpu.run(_base_params(), verbose=False)
+    prms, lres = best
+    y = np.column_stack([v for _, v in lres])
+    d = distance_to_front(y, zdt1_pareto(500))
+    # solution-quality oracle in the style of the reference ZDT tests
+    assert (d < 0.1).sum() >= 10, (y.shape, float(np.median(d)))
+
+
+def test_run_no_surrogate():
+    params = _base_params(
+        surrogate_method_name=None, n_epochs=1, num_generations=5,
+        population_size=32,
+    )
+    best = dmosopt_tpu.run(params, verbose=False)
+    prms, lres = best
+    assert len(prms) == N_DIM
+    assert len(lres) == 2
+
+
+def test_run_jax_objective_batch():
+    import jax.numpy as jnp
+
+    def zdt1_batch(X):
+        f1 = X[:, 0]
+        g = 1.0 + 9.0 / (X.shape[1] - 1) * jnp.sum(X[:, 1:], axis=1)
+        f2 = g * (1.0 - jnp.sqrt(f1 / g))
+        return jnp.stack([f1, f2], axis=1)
+
+    params = _base_params(
+        obj_fun=zdt1_batch, jax_objective=True, n_epochs=2,
+    )
+    best = dmosopt_tpu.run(params, verbose=False)
+    prms, lres = best
+    y = np.column_stack([v for _, v in lres])
+    d = distance_to_front(y, zdt1_pareto(500))
+    assert (d < 0.2).sum() >= 5
+
+
+def test_run_optimizer_cycling_and_problem_ids():
+    # optimizer cycling: nsga2 on epoch 0, nsga2 again epoch 1 (single name
+    # cycles trivially); multi-problem multiplexing via problem_ids
+    def mp_obj(mpp):
+        out = {}
+        for pid, pp in mpp.items():
+            scale = 1.0 + 0.1 * pid
+            x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+            f1 = scale * x[0]
+            g = 1.0 + 9.0 / (N_DIM - 1) * np.sum(x[1:])
+            f2 = g * (1.0 - np.sqrt(np.clip(f1 / g, 0, None)))
+            out[pid] = np.array([f1, f2])
+        return out
+
+    params = _base_params(
+        obj_fun=mp_obj,
+        problem_ids=set([0, 1]),
+        n_epochs=2,
+        num_generations=10,
+        population_size=32,
+        n_initial=4,
+    )
+    best = dmosopt_tpu.run(params, verbose=False)
+    assert set(best.keys()) == {0, 1}
+
+
+def test_unequal_multiproblem_queues_do_not_deadlock():
+    """Per-problem request queues of different lengths (e.g. after resample
+    dedupe) must still drain — partial evaluation rounds are allowed."""
+
+    def mp_obj(mpp):
+        out = {}
+        for pid, pp in mpp.items():
+            x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+            out[pid] = np.array([x[0] + 0.01 * pid, 1.0 - x[0]])
+        return out
+
+    params = _base_params(
+        obj_fun=mp_obj,
+        problem_ids=set([0, 1]),
+        n_epochs=2,
+        num_generations=8,
+        population_size=16,
+        n_initial=3,
+    )
+    import dmosopt_tpu.driver as driver
+
+    dopt = driver.dopt_init(params, verbose=False, initialize_strategy=True)
+    # force unequal queues before the run
+    extra = np.full((N_DIM,), 0.5)
+    dopt.optimizer_dict[1].append_request(
+        dmosopt_tpu.EvalRequest(extra, None, 0)
+    )
+    while dopt.epoch_count < dopt.n_epochs:
+        dopt.run_epoch()
+    # all queues drained, both problems produced results
+    for pid in (0, 1):
+        assert not dopt.optimizer_dict[pid].has_requests()
+        assert dopt.optimizer_dict[pid].x is not None
+
+
+def test_time_limit_soft_stop():
+    import time as _time
+
+    calls = {"n": 0}
+
+    def slow_obj(pp):
+        calls["n"] += 1
+        _time.sleep(0.05)
+        return zdt1_obj(pp)
+
+    params = _base_params(
+        obj_fun=slow_obj, n_epochs=5, num_generations=5, population_size=16,
+        surrogate_method_name=None,
+    )
+    t0 = _time.time()
+    dmosopt_tpu.run(params, time_limit=2.0, verbose=False)
+    # must return promptly after the limit, not loop forever
+    assert _time.time() - t0 < 30.0
+
+
+def test_run_validates_params():
+    with pytest.raises(ValueError):
+        dmosopt_tpu.run({"opt_id": "x", "obj_fun": zdt1_obj,
+                         "objective_names": ["f1"]}, verbose=False)

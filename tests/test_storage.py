@@ -1,0 +1,139 @@
+"""HDF5 persistence and checkpoint/resume tests
+(reference semantics: dmosopt/dmosopt.py:1474-2324, §5.4 of SURVEY)."""
+
+import numpy as np
+import pytest
+
+import dmosopt_tpu
+from dmosopt_tpu import storage
+from dmosopt_tpu.datatypes import ParameterSpace
+
+h5py = pytest.importorskip("h5py")
+
+N_DIM = 6
+
+
+def zdt1_obj(pp):
+    x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+    f1 = x[0]
+    g = 1.0 + 9.0 / (N_DIM - 1) * np.sum(x[1:])
+    f2 = g * (1.0 - np.sqrt(f1 / g))
+    return np.array([f1, f2])
+
+
+def _params(file_path, **over):
+    params = {
+        "opt_id": "zdt1_store",
+        "obj_fun": zdt1_obj,
+        "objective_names": ["f1", "f2"],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(N_DIM)},
+        "problem_parameters": {"beta": 0.5},
+        "n_initial": 6,
+        "n_epochs": 2,
+        "population_size": 32,
+        "num_generations": 10,
+        "resample_fraction": 0.5,
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 30, "seed": 0},
+        "random_seed": 17,
+        "save": True,
+        "save_eval": 5,
+        "save_surrogate_evals": True,
+        "file_path": str(file_path),
+        "metadata": {"note": "unit-test"},
+    }
+    params.update(over)
+    return params
+
+
+def zdt1_obj_with_beta(pp):
+    assert "beta" in pp  # problem parameter must be merged in
+    return zdt1_obj(pp)
+
+
+def test_space_json_roundtrip():
+    space = ParameterSpace.from_dict(
+        {"a": [0, 1], "grp": {"b": [1, 5, True], "c": [-2.0, 2.0]}}
+    )
+    s = storage._space_to_json(space)
+    space2 = storage._space_from_json(s)
+    assert space2.parameter_names == space.parameter_names
+    assert np.allclose(space2.bound1, space.bound1)
+    assert np.allclose(space2.bound2, space.bound2)
+    assert list(space2.is_integer) == list(space.is_integer)
+
+
+def test_save_creates_layout(tmp_path):
+    fp = tmp_path / "run.h5"
+    # surrogate-eval logs require an epoch with advance_epoch and epoch>0
+    # (reference dmosopt.py:1451-1462), i.e. >= 3 epochs
+    dmosopt_tpu.run(
+        _params(fp, obj_fun=zdt1_obj_with_beta, n_epochs=3, num_generations=5),
+        verbose=False,
+    )
+    with h5py.File(fp, "r") as h5:
+        grp = h5["zdt1_store"]
+        assert int(grp["random_seed"][()]) == 17
+        p = grp["0"]
+        n = p["parameters"].shape[0]
+        assert n > 0
+        assert p["objectives"].shape == (n, 2)
+        assert p["epochs"].shape == (n,)
+        assert p["predictions"].shape[0] == n
+        # epoch-1 resample evals carry surrogate predictions
+        preds = p["predictions"][:]
+        assert np.isfinite(preds).any()
+        assert "surrogate_evals" in p
+        assert "optimizer_params" in p
+
+
+def test_resume_continues_without_reeval(tmp_path):
+    fp = tmp_path / "resume.h5"
+    dmosopt_tpu.run(_params(fp, n_epochs=2), verbose=False)
+    with h5py.File(fp, "r") as h5:
+        n_before = h5["zdt1_store"]["0"]["parameters"].shape[0]
+        max_epoch_before = int(h5["zdt1_store"]["0"]["epochs"][:].max())
+
+    # resume: same file, 2 more epochs (the final epoch of any run does not
+    # evaluate its resamples, so a 1-epoch resume adds no real evals)
+    dmosopt_tpu.run(_params(fp, n_epochs=2), verbose=False)
+    with h5py.File(fp, "r") as h5:
+        X = h5["zdt1_store"]["0"]["parameters"][:]
+        epochs = h5["zdt1_store"]["0"]["epochs"][:]
+    assert X.shape[0] > n_before
+    # the resumed run starts from a later epoch, not epoch 0
+    assert int(epochs.max()) > max_epoch_before
+    # no point should be evaluated twice
+    from scipy.spatial.distance import cdist
+
+    D = cdist(X, X)
+    np.fill_diagonal(D, np.inf)
+    assert (D < 1e-12).sum() == 0
+
+
+def test_init_from_h5_name_mismatch(tmp_path):
+    fp = tmp_path / "mismatch.h5"
+    dmosopt_tpu.run(_params(fp, n_epochs=1), verbose=False)
+    with pytest.raises(RuntimeError):
+        storage.init_from_h5(str(fp), ["wrong", "names"], "zdt1_store")
+
+
+def test_resume_restores_space_from_file_alone(tmp_path):
+    # file_path-only init: space/problem_parameters come from the store
+    fp = tmp_path / "fileonly.h5"
+    dmosopt_tpu.run(_params(fp, n_epochs=1), verbose=False)
+    params = {
+        "opt_id": "zdt1_store",
+        "obj_fun": zdt1_obj,
+        "n_epochs": 1,
+        "population_size": 32,
+        "num_generations": 5,
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 20, "seed": 1},
+        "file_path": str(fp),
+        "save": True,
+    }
+    best = dmosopt_tpu.run(params, verbose=False)
+    prms, lres = best
+    assert len(prms) == N_DIM
