@@ -1,0 +1,437 @@
+"""Hypervolume stack: exact (2-D staircase, d-D local upper bounds), Monte
+Carlo estimators, batched EHVI, and an adaptive routing facade.
+
+Capability match: reference `dmosopt/hv.py` (AdaptiveHyperVolume routing
+exact box decomposition for d<10 and MC/hybrid for d>=10, :77-189, MC
+fallback :191-241, confidence-interval API :272), reference
+`dmosopt/hv_box_decomposition.py` (Lacour/Klamroth/Fonseca local-upper-
+bound exact HV :62-248; batch EHVI over a staircase decomposition
+:306-416), and reference `dmosopt/hv_adaptive.py` (MC estimators with
+adaptive sample counts).
+
+TPU redesign:
+- The MC estimator is the on-device workhorse: uniform sampling + a
+  dominance mask reduction is one fused XLA program
+  (`hypervolume_mc`), batched over sample blocks with `lax.scan` so
+  sample counts scale without memory blow-up.
+- EHVI scoring is a closed-form product of Gaussian partial
+  expectations over boxes — pure elementwise math, jitted and batched
+  over (candidates x boxes x objectives) (`ehvi_batch`).
+- The exact d-D local-upper-bound construction is inherently sequential
+  and combinatorial; it stays host-side NumPy (it runs on small Pareto
+  fronts), per the build plan (SURVEY §7 "Hard parts"). The 2-D exact
+  path is a jitted sort+sum.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+# ------------------------------------------------------------- exact, 2-D
+
+
+@jax.jit
+def hypervolume_2d(points: jax.Array, ref_point: jax.Array) -> jax.Array:
+    """Exact 2-D hypervolume via the staircase sweep (minimization), as one
+    jitted program: points outside the reference box are masked to +inf so
+    they neither contribute area nor advance the staircase; dominated
+    points contribute zero via the prefix-min.
+    """
+    inside = jnp.all(points < ref_point, axis=1)
+    x = jnp.where(inside, points[:, 0], jnp.inf)
+    y = jnp.where(inside, points[:, 1], jnp.inf)
+    order = jnp.argsort(x)
+    xs, ys = x[order], y[order]
+    cummin = jax.lax.associative_scan(jnp.minimum, ys)
+    prev_best = jnp.concatenate(
+        [ref_point[1][None], jnp.minimum(cummin[:-1], ref_point[1])]
+    )
+    width = jnp.where(jnp.isfinite(xs), ref_point[0] - xs, 0.0)
+    height = jnp.maximum(prev_best - ys, 0.0)
+    height = jnp.where(jnp.isfinite(height), height, 0.0)
+    return jnp.sum(width * height)
+
+
+# ----------------------------------------------------- exact, d dimensions
+
+
+def _filter_dominated(points: np.ndarray) -> np.ndarray:
+    """Keep the non-dominated subset (minimization)."""
+    n = len(points)
+    if n <= 1:
+        return points
+    le = np.all(points[:, None, :] <= points[None, :, :], axis=2)
+    lt = np.any(points[:, None, :] < points[None, :, :], axis=2)
+    dominated = np.any(le & lt, axis=0)
+    return points[~dominated]
+
+
+def _hypervolume_wfg(points: np.ndarray, ref_point: np.ndarray) -> float:
+    """WFG-style exclusive-volume recursion — an independent exact oracle
+    used to cross-check the box decomposition (exponential worst case;
+    test-sized inputs only)."""
+    points = _filter_dominated(points[np.all(points < ref_point, axis=1)])
+    n = len(points)
+    if n == 0:
+        return 0.0
+    pts = points[np.argsort(points[:, 0])[::-1]]
+    total = 0.0
+    for i in range(n):
+        p = pts[i]
+        box = float(np.prod(ref_point - p))
+        rest = pts[i + 1 :]
+        if len(rest) > 0:
+            box -= _hypervolume_wfg(np.maximum(rest, p), ref_point)
+        total += box
+    return total
+
+
+def hypervolume_exact(points: np.ndarray, ref_point: np.ndarray) -> float:
+    """Exact hypervolume for minimization w.r.t. ``ref_point``.
+
+    d<=2 uses the host staircase sweep; d>=3 sums the disjoint
+    dominated-region boxes from the local-upper-bound decomposition
+    (Lacour et al. 2017) — the same algorithm family as the reference
+    exact path (hv_box_decomposition.py:86-129).
+    """
+    points = np.asarray(points, dtype=np.float64)
+    ref_point = np.asarray(ref_point, dtype=np.float64)
+    if points.ndim != 2 or points.shape[0] == 0:
+        return 0.0
+    points = points[np.all(points < ref_point, axis=1)]
+    points = _filter_dominated(points)
+    n, d = points.shape
+    if n == 0:
+        return 0.0
+    if d == 1:
+        return float(ref_point[0] - points[:, 0].min())
+    if d == 2:
+        pts = points[np.argsort(points[:, 0])]
+        hv = 0.0
+        best_f2 = ref_point[1]
+        for x1, x2 in pts:
+            if x2 < best_f2:
+                hv += (ref_point[0] - x1) * (best_f2 - x2)
+                best_f2 = x2
+        return float(hv)
+    lowers, uppers = dominated_boxes(points, ref_point)
+    return float(np.sum(np.prod(uppers - lowers, axis=1)))
+
+
+# ------------------------------------------------------------- Monte Carlo
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _mc_dominated_count(
+    key: jax.Array, points: jax.Array, bounds: Tuple, n_samples: int
+) -> jax.Array:
+    lo, hi = bounds
+    # sample in blocks under scan to bound memory at any n_samples
+    block = 4096
+    n_blocks = (n_samples + block - 1) // block
+
+    def body(carry, k):
+        s = jax.random.uniform(k, (block, points.shape[1]), points.dtype)
+        s = lo + s * (hi - lo)
+        dominated = jnp.any(
+            jnp.all(points[None, :, :] <= s[:, None, :], axis=2), axis=1
+        )
+        return carry + dominated.sum(), None
+
+    keys = jax.random.split(key, n_blocks)
+    count, _ = jax.lax.scan(body, jnp.zeros((), jnp.int32), keys)
+    return count, n_blocks * block
+
+
+def hypervolume_mc(
+    points,
+    ref_point,
+    n_samples: int = 100_000,
+    key: Optional[jax.Array] = None,
+    return_ci: bool = False,
+):
+    """Monte Carlo hypervolume estimate (minimization), on device.
+
+    Samples uniformly in the [ideal, ref] bounding box and counts
+    dominated samples (reference: dmosopt/hv.py:191-241). Returns the
+    estimate, optionally with a 95% confidence half-width.
+    """
+    points = jnp.asarray(points, jnp.float32)
+    ref_point = jnp.asarray(ref_point, jnp.float32)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    inside = jnp.all(points < ref_point, axis=1)
+    big = jnp.where(inside[:, None], points, ref_point[None, :])
+    lo = jnp.min(big, axis=0)
+    lo = jnp.where(jnp.isfinite(lo), lo, ref_point)
+    box_vol = jnp.prod(ref_point - lo)
+    count, total = _mc_dominated_count(key, big, (lo, ref_point), int(n_samples))
+    frac = count / total
+    hv = float(box_vol * frac)
+    if return_ci:
+        se = float(jnp.sqrt(frac * (1.0 - frac) / total) * box_vol)
+        return hv, 1.96 * se
+    return hv
+
+
+# -------------------------------------------- dominated-region decomposition
+
+
+def local_upper_bounds(
+    front: np.ndarray, ref_point: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Local upper bounds of a non-dominated front with their defining
+    points, via the nonincremental algorithm of Lacour, Klamroth & Fonseca
+    (2017) — the algorithm behind the reference exact HV path
+    (hv_box_decomposition.py:165-248; this is an independent
+    implementation of the published algorithm, with -inf dummy coordinates
+    so it is correct for objectives of any sign).
+
+    Returns (ubs, defs): ubs (M, d) upper-bound coordinates; defs (M, d)
+    coordinates z^k_j(u) of the defining point of each dimension — laid
+    out as defs[m, k, j] = j-th coordinate of the defining point for
+    dimension k of upper bound m, shape (M, d, d).
+    """
+    front = np.asarray(front, dtype=np.float64)
+    ref_point = np.asarray(ref_point, dtype=np.float64)
+    n, d = front.shape
+
+    # dummy defining point for dimension k: coordinate k = ref_k, else -inf
+    dummy = np.full((d, d), -np.inf)
+    np.fill_diagonal(dummy, ref_point)
+
+    ubs = [ref_point.copy()]
+    defs = [dummy.copy()]  # defs[m][k] = defining point (d,) for dim k
+
+    order = np.argsort(front[:, -1])
+    for z in front[order]:
+        U = np.asarray(ubs)
+        dominated = np.all(z < U, axis=1)  # strictly dominated LUBs (set A)
+        if not dominated.any():
+            continue
+        keep_ubs = [u for u, m in zip(ubs, dominated) if not m]
+        keep_defs = [q for q, m in zip(defs, dominated) if not m]
+        new_ubs, new_defs = [], []
+        for u, q in ((u, q) for u, q, m in zip(ubs, defs, dominated) if m):
+            # update in the last dimension unconditionally
+            nu = u.copy()
+            nu[-1] = z[-1]
+            nq = q.copy()
+            nq[-1] = z
+            new_ubs.append(nu)
+            new_defs.append(nq)
+            # update in dimension j < d-1 only if z_j >= max_{k!=j} z^k_j(u)
+            for j in range(d - 1):
+                other = np.delete(q[:, j], j)
+                if np.max(other) < z[j]:
+                    nu = u.copy()
+                    nu[j] = z[j]
+                    nq = q.copy()
+                    nq[j] = z
+                    new_ubs.append(nu)
+                    new_defs.append(nq)
+        ubs = keep_ubs + new_ubs
+        defs = keep_defs + new_defs
+        # dedupe by coordinates
+        seen = {}
+        for u, q in zip(ubs, defs):
+            seen.setdefault(tuple(u), (u, q))
+        ubs = [v[0] for v in seen.values()]
+        defs = [v[1] for v in seen.values()]
+
+    return np.asarray(ubs), np.asarray(defs)
+
+
+def dominated_boxes(
+    front: np.ndarray, ref_point: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Disjoint boxes partitioning the region dominated by `front` within
+    the reference box (Lacour et al. eq. (2)): for each local upper bound
+    u, B(u) = [z^1_1(u), r_1] x prod_{j>=2} [max_{k<j} z^k_j(u), u_j].
+    Degenerate boxes are dropped. Returns (lowers, uppers), each (B, d)."""
+    front = np.asarray(front, dtype=np.float64)
+    ref_point = np.asarray(ref_point, dtype=np.float64)
+    if front.shape[0] == 0:
+        return np.zeros((0, len(ref_point))), np.zeros((0, len(ref_point)))
+    ubs, defs = local_upper_bounds(front, ref_point)
+    M, d = ubs.shape
+    lowers = np.empty((M, d))
+    uppers = np.empty((M, d))
+    lowers[:, 0] = defs[:, 0, 0]  # z^1_1(u)
+    uppers[:, 0] = ref_point[0]
+    for j in range(1, d):
+        lowers[:, j] = np.max(defs[:, :j, j], axis=1)  # max_{k<j} z^k_j(u)
+        uppers[:, j] = ubs[:, j]
+    valid = np.all(uppers > lowers, axis=1) & np.all(np.isfinite(lowers), axis=1)
+    return lowers[valid], uppers[valid]
+
+
+# ------------------------------------------------------------------- EHVI
+
+
+@jax.jit
+def _psi(lo, hi, m, s):
+    """E[(hi - max(Y, lo))+] for Y ~ N(m, s^2), elementwise; lo may be -inf
+    (then the term reduces to E[(hi - Y)+])."""
+    b = (hi - m) / s
+    a = jnp.where(jnp.isinf(lo), -1e30, (lo - m) / s)
+    cdf_a = jax.scipy.stats.norm.cdf(a)
+    cdf_b = jax.scipy.stats.norm.cdf(b)
+    pdf_a = jax.scipy.stats.norm.pdf(a)
+    pdf_b = jax.scipy.stats.norm.pdf(b)
+    finite_lo = jnp.where(jnp.isinf(lo), hi, lo)  # (hi-lo)*cdf_a -> 0 at -inf
+    return (
+        (hi - finite_lo) * cdf_a
+        + (hi - m) * (cdf_b - cdf_a)
+        + s * (pdf_b - pdf_a)
+    )
+
+
+@jax.jit
+def ehvi_batch(
+    lowers: jax.Array,
+    uppers: jax.Array,
+    means: jax.Array,
+    variances: jax.Array,
+    ref_point: jax.Array,
+) -> jax.Array:
+    """Batched exact expected-hypervolume-improvement (minimization).
+
+    Identity: HVI(y) = vol(dom(y)) - vol(dom(y) & dom(front)), with
+    dom(front) partitioned into disjoint boxes (lowers, uppers]. Both
+    terms factorize over independent per-objective Gaussians:
+
+        EHVI = prod_j E[(r_j - Y_j)+]
+             - sum_k prod_j E[(u_kj - max(Y_j, l_kj))+]
+
+    One fused (candidates x boxes x objectives) kernel — the TPU
+    replacement for the reference's per-candidate Python loop
+    (hv_box_decomposition.py:353-416).
+
+    Shapes: lowers/uppers (B, d); means/variances (C, d); ref (d,) -> (C,).
+    """
+    std = jnp.sqrt(jnp.maximum(variances, 1e-12))  # (C, d)
+    total = jnp.prod(
+        _psi(jnp.full_like(means, -jnp.inf), ref_point[None, :], means, std),
+        axis=1,
+    )  # (C,)
+    if lowers.shape[0] == 0:
+        return total
+    m = means[:, None, :]  # (C, 1, d)
+    s = std[:, None, :]
+    overlap = jnp.prod(
+        _psi(lowers[None, :, :], uppers[None, :, :], m, s), axis=2
+    )  # (C, B)
+    return total - jnp.sum(overlap, axis=1)
+
+
+class HyperVolumeBoxDecomposition:
+    """EHVI candidate selector over the staircase decomposition, API-
+    compatible with the reference class used by CMAES/TRS selection
+    (reference: hv_box_decomposition.py:62-416)."""
+
+    def __init__(self, ref_point):
+        self.ref_point = np.asarray(ref_point, dtype=np.float64)
+        self.d = len(self.ref_point)
+
+    def compute_hypervolume(self, points) -> float:
+        return hypervolume_exact(points, self.ref_point)
+
+    def select_candidates(
+        self,
+        pareto_front: np.ndarray,
+        candidate_means: np.ndarray,
+        candidate_variances: np.ndarray,
+        n_select: int = 1,
+        batch_size: int = 100,
+    ):
+        """Top-`n_select` candidates by exact EHVI. Returns
+        (indices, scores)."""
+        candidate_means = np.asarray(candidate_means, dtype=np.float64)
+        candidate_variances = np.asarray(candidate_variances, dtype=np.float64)
+        pareto_front = np.asarray(pareto_front, dtype=np.float64)
+        if len(pareto_front) > 0:
+            pareto_front = _filter_dominated(
+                pareto_front[np.all(pareto_front < self.ref_point, axis=1)]
+            )
+        lowers, uppers = dominated_boxes(pareto_front, self.ref_point)
+        scores = np.asarray(
+            ehvi_batch(
+                jnp.asarray(lowers, jnp.float32),
+                jnp.asarray(uppers, jnp.float32),
+                jnp.asarray(candidate_means, jnp.float32),
+                jnp.asarray(candidate_variances, jnp.float32),
+                jnp.asarray(self.ref_point, jnp.float32),
+            )
+        )
+        selected = np.argsort(-scores)[:n_select].copy()
+        return selected, scores[selected]
+
+
+# ------------------------------------------------------------------ facade
+
+
+class AdaptiveHyperVolume:
+    """Routing facade (reference: dmosopt/hv.py:77-189): exact computation
+    for low dimension / small fronts, Monte Carlo above, with an optional
+    confidence-interval API."""
+
+    def __init__(
+        self,
+        ref_point,
+        exact_dim_threshold: int = 10,
+        exact_size_threshold: int = 300,
+        mc_samples: int = 100_000,
+        seed: int = 0,
+    ):
+        self.ref_point = np.asarray(ref_point, dtype=np.float64)
+        self.d = len(self.ref_point)
+        self.exact_dim_threshold = exact_dim_threshold
+        self.exact_size_threshold = exact_size_threshold
+        self.mc_samples = mc_samples
+        self._key = jax.random.PRNGKey(seed)
+        self.last_method = None
+
+    def _use_exact(self, n: int) -> bool:
+        if self.d <= 2:
+            return True
+        return (
+            self.d < self.exact_dim_threshold and n <= self.exact_size_threshold
+        )
+
+    def compute_hypervolume(self, points) -> float:
+        points = np.asarray(points, dtype=np.float64)
+        n = points.shape[0] if points.ndim == 2 else 0
+        if n == 0:
+            self.last_method = "exact"
+            return 0.0
+        if self._use_exact(n):
+            self.last_method = "exact"
+            return hypervolume_exact(points, self.ref_point)
+        self.last_method = "mc"
+        self._key, k = jax.random.split(self._key)
+        return hypervolume_mc(
+            points, self.ref_point, n_samples=self.mc_samples, key=k
+        )
+
+    def compute_hypervolume_with_confidence(self, points):
+        """Returns (estimate, ci_halfwidth); exact results have zero CI."""
+        points = np.asarray(points, dtype=np.float64)
+        n = points.shape[0] if points.ndim == 2 else 0
+        if n == 0:
+            return 0.0, 0.0
+        if self._use_exact(n):
+            return hypervolume_exact(points, self.ref_point), 0.0
+        self._key, k = jax.random.split(self._key)
+        return hypervolume_mc(
+            points, self.ref_point, n_samples=self.mc_samples, key=k,
+            return_ci=True,
+        )
+
+    __call__ = compute_hypervolume

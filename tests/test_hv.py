@@ -1,0 +1,218 @@
+"""Hypervolume stack tests with analytical ground truths, mirroring the
+reference oracle style (reference: tests/test_hv_box_decomposition.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dmosopt_tpu import hv
+from dmosopt_tpu.indicators import (
+    Hypervolume,
+    HypervolumeImprovement,
+    IGD,
+    PopulationDiversity,
+    SlidingWindow,
+)
+
+
+# --------------------------------------------------------- analytic truths
+
+
+def test_hv_empty_and_single_point():
+    ref = np.array([2.0, 2.0])
+    assert hv.hypervolume_exact(np.zeros((0, 2)), ref) == 0.0
+    assert hv.hypervolume_exact(np.array([[1.0, 1.0]]), ref) == pytest.approx(1.0)
+    # out-of-box point contributes nothing
+    assert hv.hypervolume_exact(np.array([[3.0, 3.0]]), ref) == 0.0
+
+
+def test_hv_2d_staircase():
+    ref = np.array([3.0, 3.0])
+    pts = np.array([[1.0, 2.0], [2.0, 1.0]])
+    # two unit-overlapping rectangles: 2*2 + 1*2 - overlap -> compute directly
+    # box1 = (3-1)*(3-2)=2 ; box2 adds (3-2)*(2-1)=1 -> 3
+    assert hv.hypervolume_exact(pts, ref) == pytest.approx(3.0)
+    # dominated point changes nothing
+    pts2 = np.vstack([pts, [[2.5, 2.5]]])
+    assert hv.hypervolume_exact(pts2, ref) == pytest.approx(3.0)
+
+
+def test_hv_2d_jitted_matches_host():
+    ref = np.array([3.0, 3.0], dtype=np.float32)
+    rng = np.random.default_rng(0)
+    pts = rng.uniform(0, 4, size=(40, 2)).astype(np.float32)
+    got = float(hv.hypervolume_2d(jnp.asarray(pts), jnp.asarray(ref)))
+    want = hv.hypervolume_exact(pts, ref)
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_hv_3d_cube():
+    ref = np.array([1.0, 1.0, 1.0])
+    # single point at origin dominates the whole unit cube
+    assert hv.hypervolume_exact(np.zeros((1, 3)), ref) == pytest.approx(1.0)
+    # two points: [0,0,.5] and [.5,.5,0]:
+    # vol = 0.5 + 0.25*0.5 = 0.625
+    pts = np.array([[0.0, 0.0, 0.5], [0.5, 0.5, 0.0]])
+    assert hv.hypervolume_exact(pts, ref) == pytest.approx(0.625)
+
+
+def test_hv_mc_close_to_exact():
+    rng = np.random.default_rng(1)
+    # random 3-obj front
+    pts = rng.uniform(0, 1, size=(30, 3))
+    ref = np.array([1.1, 1.1, 1.1])
+    exact = hv.hypervolume_exact(pts, ref)
+    est, ci = hv.hypervolume_mc(
+        pts, ref, n_samples=200_000, key=jax.random.PRNGKey(2), return_ci=True
+    )
+    assert abs(est - exact) < max(4 * ci, 0.02 * exact)
+
+
+def test_adaptive_facade_routing():
+    ref2 = np.array([2.0, 2.0])
+    ahv = hv.AdaptiveHyperVolume(ref2)
+    assert ahv.compute_hypervolume(np.array([[1.0, 1.0]])) == pytest.approx(1.0)
+    assert ahv.last_method == "exact"
+
+    d = 12
+    ref = np.full(d, 1.0)
+    ahv = hv.AdaptiveHyperVolume(ref, mc_samples=20_000)
+    pts = np.random.default_rng(3).uniform(0, 1, size=(50, d)) * 0.9
+    v = ahv.compute_hypervolume(pts)
+    assert ahv.last_method == "mc"
+    assert 0.0 < v < 1.0
+    est, ci = ahv.compute_hypervolume_with_confidence(pts)
+    assert ci > 0.0
+
+
+# ------------------------------------------- box decomposition cross-checks
+
+
+def test_box_decomposition_matches_wfg_oracle():
+    rng = np.random.default_rng(5)
+    for d in (3, 4):
+        ref = np.full(d, 1.2)
+        pts = rng.uniform(0, 1, size=(15, d))
+        got = hv.hypervolume_exact(pts, ref)
+        want = hv._hypervolume_wfg(pts.copy(), ref)
+        assert got == pytest.approx(want, rel=1e-9), (d, got, want)
+
+
+def test_dominated_boxes_partition_volume_2d():
+    # in 2-D the box-decomposition volume must equal the staircase sweep
+    rng = np.random.default_rng(6)
+    pts = rng.uniform(0, 1, size=(12, 2))
+    ref = np.array([1.1, 1.1])
+    lowers, uppers = hv.dominated_boxes(
+        hv._filter_dominated(pts), ref
+    )
+    vol = float(np.sum(np.prod(uppers - lowers, axis=1)))
+    assert vol == pytest.approx(hv.hypervolume_exact(pts, ref), rel=1e-9)
+
+
+# -------------------------------------------------------------------- EHVI
+
+
+def test_ehvi_prefers_improving_candidate():
+    ref = np.array([2.0, 2.0])
+    front = np.array([[1.0, 1.0]])
+    box = hv.HyperVolumeBoxDecomposition(ref)
+    means = np.array([[0.5, 0.5], [1.5, 1.5]])  # first dominates the front
+    variances = np.full((2, 2), 0.01)
+    idx, scores = box.select_candidates(front, means, variances, n_select=1)
+    assert scores[0] > 0
+    assert int(idx[0]) == 0
+
+
+def test_ehvi_empty_front():
+    box = hv.HyperVolumeBoxDecomposition(np.array([1.0, 1.0]))
+    means = np.array([[0.2, 0.2], [0.8, 0.8]])
+    variances = np.full((2, 2), 0.01)
+    idx, scores = box.select_candidates(
+        np.zeros((0, 2)), means, variances, n_select=2
+    )
+    assert int(idx[0]) == 0  # deeper-dominating candidate wins
+
+
+def test_ehvi_matches_monte_carlo_expectation():
+    """EHVI formula vs brute-force E[HV(front+y) - HV(front)]."""
+    rng = np.random.default_rng(7)
+    ref = np.array([2.0, 2.0])
+    front = np.array([[0.4, 1.5], [1.0, 1.0], [1.6, 0.3]])
+    mean = np.array([[0.8, 0.7]])
+    var = np.array([[0.04, 0.09]])
+    box = hv.HyperVolumeBoxDecomposition(ref)
+    _, score = box.select_candidates(front, mean, var, n_select=1)
+
+    hv0 = hv.hypervolume_exact(front, ref)
+    samples = rng.normal(mean[0], np.sqrt(var[0]), size=(4000, 2))
+    hvi = [
+        hv.hypervolume_exact(np.vstack([front, s[None, :]]), ref) - hv0
+        for s in samples
+    ]
+    mc = float(np.mean(hvi))
+    se = float(np.std(hvi) / np.sqrt(len(hvi)))
+    assert score[0] == pytest.approx(mc, abs=max(4 * se, 0.01))
+
+
+def test_ehvi_3d_matches_monte_carlo():
+    rng = np.random.default_rng(8)
+    ref = np.full(3, 1.5)
+    front = np.array([[0.5, 0.9, 0.8], [0.9, 0.4, 0.9], [0.8, 0.8, 0.3]])
+    mean = np.array([[0.6, 0.6, 0.6]])
+    var = np.full((1, 3), 0.02)
+    box = hv.HyperVolumeBoxDecomposition(ref)
+    _, score = box.select_candidates(front, mean, var, n_select=1)
+
+    hv0 = hv.hypervolume_exact(front, ref)
+    samples = rng.normal(mean[0], np.sqrt(var[0]), size=(3000, 3))
+    hvi = [
+        hv.hypervolume_exact(np.vstack([front, s[None, :]]), ref) - hv0
+        for s in samples
+    ]
+    mc, se = float(np.mean(hvi)), float(np.std(hvi) / np.sqrt(len(hvi)))
+    assert score[0] == pytest.approx(mc, abs=max(4 * se, 0.01))
+
+
+# -------------------------------------------------------------- indicators
+
+
+def test_igd_zero_on_front_itself():
+    pf = np.array([[0.0, 1.0], [0.5, 0.5], [1.0, 0.0]])
+    igd = IGD(pf)
+    assert igd.do(pf) == pytest.approx(0.0)
+    assert igd.do(pf + 0.1) > 0
+
+
+def test_hypervolume_indicator_nds():
+    ref = np.array([2.0, 2.0])
+    ind = Hypervolume(ref_point=ref, nds=True, norm_ref_point=False)
+    F = np.array([[1.0, 1.0], [1.5, 1.5]])  # second dominated
+    assert ind.do(F) == pytest.approx(1.0)
+
+
+def test_hvi_indicator_selects_k():
+    ind = HypervolumeImprovement(
+        ref_point=np.array([2.0, 2.0]), norm_ref_point=False
+    )
+    F = np.array([[1.0, 1.0]])
+    means = np.array([[0.5, 0.5], [1.8, 1.8], [0.6, 0.4]])
+    var = np.full((3, 2), 0.01)
+    sel = ind.do(F, means, var, 2)
+    assert len(sel) == 2
+    assert 1 not in sel  # the non-improving candidate is not picked
+
+
+def test_population_diversity_and_sliding_window():
+    pd = PopulationDiversity()
+    F = np.array([0, 0, 1, 1])
+    Y = np.array([[0.0, 1.0], [1.0, 0.0], [2.0, 2.0], [3.0, 3.0]])
+    diversity, spread = pd.do(F[None, :], Y)
+    assert diversity == pytest.approx(0.5)
+
+    w = SlidingWindow(3)
+    for i in range(5):
+        w.append(i)
+    assert list(w) == [2, 3, 4]
+    assert w.is_full()
