@@ -134,6 +134,14 @@ def _optimize_on_device(
     bounds = optimizer.bounds
     state = optimizer.state
 
+    if not getattr(optimizer, "jit_compatible", True):
+        # optimizers with host-side selection (EHVI mid-front breaking in
+        # CMAES/TRS) run a per-generation host loop; the surrogate predict
+        # and their inner kernels are still jitted
+        return _optimize_host_loop(
+            optimizer, eval_fn, num_generations, termination, logger
+        )
+
     def step(state, k):
         x_gen, state = optimizer.generate_strategy(k, state)
         x_gen = jnp.clip(x_gen, bounds[:, 0], bounds[:, 1])
@@ -190,6 +198,46 @@ def _optimize_on_device(
         return (
             np.zeros((0, noff, optimizer.nInput), np.float32),
             np.zeros((0, noff, n_obj_cols), np.float32),
+            0,
+        )
+    return np.concatenate(x_chunks), np.concatenate(y_chunks), gen
+
+
+def _optimize_host_loop(optimizer, eval_fn, num_generations, termination, logger):
+    """Per-generation host loop for non-scannable optimizers (their
+    randomness flows through `optimizer.local_random`, not a jax key).
+    Same return contract as the scan path: (x_traj, y_traj, n_gen_run)."""
+    x_chunks, y_chunks = [], []
+    n_eval = 0
+    gen = 0
+    it = itertools.count(1) if termination is not None else range(1, num_generations + 1)
+    for i in it:
+        if termination is not None:
+            pop_x, pop_y = optimizer.population_objectives
+            opt = OptHistory(i, n_eval, _as_np(pop_x), _as_np(pop_y), None)
+            if termination.has_terminated(opt):
+                if logger is not None:
+                    logger.info(
+                        f"{optimizer.name}: terminated by criterion at "
+                        f"generation {i}"
+                    )
+                break
+        x_gen, state_gen = optimizer.generate()
+        y_gen = _as_np(eval_fn(jnp.asarray(x_gen))).astype(np.float32)
+        optimizer.update(x_gen, y_gen, state_gen)
+        n_eval += x_gen.shape[0]
+        x_chunks.append(_as_np(x_gen)[None])
+        y_chunks.append(y_gen[None])
+        gen = i
+    if not x_chunks:
+        n_obj_cols = int(
+            jax.eval_shape(
+                eval_fn, jax.ShapeDtypeStruct((1, optimizer.nInput), jnp.float32)
+            ).shape[1]
+        )
+        return (
+            np.zeros((0, 0, optimizer.nInput), np.float32),
+            np.zeros((0, 0, n_obj_cols), np.float32),
             0,
         )
     return np.concatenate(x_chunks), np.concatenate(y_chunks), gen

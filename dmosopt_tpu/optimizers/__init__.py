@@ -1,0 +1,6 @@
+from dmosopt_tpu.optimizers.base import MOEA, Struct, run_ea_loop  # noqa: F401
+from dmosopt_tpu.optimizers.nsga2 import NSGA2  # noqa: F401
+from dmosopt_tpu.optimizers.agemoea import AGEMOEA  # noqa: F401
+from dmosopt_tpu.optimizers.cmaes import CMAES  # noqa: F401
+from dmosopt_tpu.optimizers.smpso import SMPSO  # noqa: F401
+from dmosopt_tpu.optimizers.trs import TRS  # noqa: F401

@@ -191,9 +191,7 @@ def run_ea_loop(
     predictor or analytic benchmark). This is the on-device replacement for
     the reference's per-generation Python loop (dmosopt/MOASMO.py:83-116).
     """
-    bounds = opt.bounds
-
-    def step(state, k):
+    def step_with_bounds(bounds, state, k):
         kg, _ = jax.random.split(k)
         x_gen, state = opt.generate_strategy(kg, state)
         x_gen = jnp.clip(x_gen, bounds[:, 0], bounds[:, 1])
@@ -203,18 +201,18 @@ def run_ea_loop(
 
     # the jit wrapper matters: an un-jitted lax.scan dispatches eagerly and
     # pays device round-trip latency per op (~30x slower over a tunneled
-    # TPU). The compiled program is cached on the optimizer keyed by the
-    # eval function so repeated calls don't retrace.
-    cache = getattr(opt, "_run_loop_cache", None)
-    if cache is None:
-        cache = opt._run_loop_cache = {}
-    run = cache.get(eval_fn)
-    if run is None:
+    # TPU). One compiled program is cached per optimizer (keyed by eval_fn,
+    # size 1 — the common case is one surrogate/benchmark per optimizer);
+    # bounds are traced arguments, not closure constants, so re-initializing
+    # with different bounds cannot serve stale clips.
+    cached = getattr(opt, "_run_loop_cache", None)
+    if cached is None or cached[0] is not eval_fn:
 
         @jax.jit
-        def run(state, keys):
-            return jax.lax.scan(step, state, keys)[0]
+        def run(bounds, state, keys):
+            body = lambda s, k: step_with_bounds(bounds, s, k)
+            return jax.lax.scan(body, state, keys)[0]
 
-        cache[eval_fn] = run
+        opt._run_loop_cache = cached = (eval_fn, run)
 
-    return run(state, jax.random.split(key, n_generations))
+    return cached[1](opt.bounds, state, jax.random.split(key, n_generations))

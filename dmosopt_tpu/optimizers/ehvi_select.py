@@ -1,0 +1,77 @@
+"""Shared front-fill + EHVI mid-front survival selection.
+
+Both MO-CMA-ES and TRS fill the next population front-by-front and break
+the first front that does not fit with expected-hypervolume-improvement
+scores (reference: dmosopt/CMAES.py:167-230 and dmosopt/TRS.py:199-266 —
+the logic is duplicated verbatim in the reference; here it is one
+function). EHVI scoring runs on device (dmosopt_tpu.hv.ehvi_batch).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from dmosopt_tpu.indicators import HypervolumeImprovement
+from dmosopt_tpu.ops import non_dominated_rank
+
+
+def ehvi_front_selection(
+    candidates_y: np.ndarray,
+    popsize: int,
+    indicator_cls=HypervolumeImprovement,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Select exactly `popsize` of the candidates (when more are offered).
+
+    Returns (chosen, not_chosen, rank): boolean masks over candidates and
+    the non-dominated rank of every candidate.
+    """
+    n_cand = candidates_y.shape[0]
+    rank = np.asarray(non_dominated_rank(jnp.asarray(candidates_y, jnp.float32)))
+    if n_cand <= popsize:
+        return (
+            np.ones(n_cand, dtype=bool),
+            np.zeros(n_cand, dtype=bool),
+            rank,
+        )
+
+    chosen = np.zeros(n_cand, dtype=bool)
+    not_chosen = np.zeros(n_cand, dtype=bool)
+    mid_front: Optional[np.ndarray] = None
+    chosen_count = 0
+    full = False
+    for r in range(int(rank.max()) + 1):
+        front_r = np.flatnonzero(rank == r)
+        if chosen_count + len(front_r) <= popsize and not full:
+            chosen[front_r] = True
+            chosen_count += len(front_r)
+        elif mid_front is None and chosen_count < popsize:
+            mid_front = front_r.copy()
+            full = True
+        else:
+            not_chosen[front_r] = True
+
+    k = popsize - chosen_count
+    if k > 0:
+        assert mid_front is not None and len(mid_front) > 0
+        # reference point: the worst candidate in each dimension + 1
+        ref = np.max(candidates_y, axis=0) + 1
+        if chosen_count > 0:
+            indicator = indicator_cls(ref_point=ref, nds=True)
+            selected = indicator.do(
+                candidates_y[chosen],
+                candidates_y[mid_front, :],
+                np.ones_like(candidates_y[mid_front, :]),
+                k,
+            )
+        else:
+            selected = np.arange(k)
+        chosen[mid_front[selected]] = True
+        rest = np.ones(len(mid_front), dtype=bool)
+        rest[selected] = False
+        not_chosen[mid_front[rest]] = True
+    elif mid_front is not None:
+        not_chosen[mid_front] = True
+    return chosen, not_chosen, rank

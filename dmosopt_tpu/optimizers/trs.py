@@ -1,0 +1,207 @@
+"""TRS: trust-region search, multi-objective local optimization.
+
+Algorithm semantics follow the reference (dmosopt/TRS.py:19-322):
+per-center trust boxes of width `tr.length` scaled by normalized bound
+weights; Sobol perturbations applied through a `min(20/dim, 1)`
+perturbation mask (Regis & Shoemaker 2013); survival by front fill with
+EHVI mid-front breaking; a success sliding window drives trust-region
+expand/shrink/restart.
+
+Like MO-CMA-ES, survival selection is data-dependent host logic
+(`jit_compatible = False`); the EHVI scores and dominance ranks run on
+device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from dmosopt_tpu.indicators import (
+    HypervolumeImprovement,
+    PopulationDiversity,
+    SlidingWindow,
+)
+from dmosopt_tpu.moasmo import remove_duplicates
+from dmosopt_tpu.optimizers.base import MOEA, Struct
+from dmosopt_tpu.optimizers.ehvi_select import ehvi_front_selection
+from dmosopt_tpu.ops import order_mo
+from dmosopt_tpu.sampling import sobol
+from dmosopt_tpu.utils.prng import as_generator
+
+
+@dataclass
+class TrState:
+    """Trust-region state (reference dmosopt/TRS.py:19-37)."""
+
+    dim: int
+    is_constrained: bool = False
+    length: float = 0.05
+    length_init: float = 0.1
+    length_min: float = 0.00001
+    length_max: float = 1.0
+    failure_tolerance: float = float("nan")
+    success_tolerance: float = 0.51
+    Y_best: np.ndarray = field(default_factory=lambda: np.asarray([np.inf]))
+    restart: bool = False
+
+    def __post_init__(self):
+        self.failure_tolerance = min(1 / self.dim, self.success_tolerance / 2.0)
+        self.Y_best = np.asarray([np.inf] * self.dim).reshape((1, -1))
+
+
+class TRS(MOEA):
+    jit_compatible = False
+
+    def __init__(
+        self,
+        popsize: int,
+        nInput: int,
+        nOutput: int,
+        model: Optional[Any] = None,
+        distance_metric=None,
+        optimize_mean_variance: bool = False,
+        **kwargs,
+    ):
+        super().__init__(
+            name="TRS", popsize=popsize, nInput=nInput, nOutput=nOutput, **kwargs
+        )
+        self.model = model
+        self.x_distance_metrics = None
+        feasibility = getattr(model, "feasibility", None) if model is not None else None
+        if feasibility is not None:
+            self.x_distance_metrics = [feasibility.rank]
+        self.indicator = HypervolumeImprovement
+        self.diversity_indicator = PopulationDiversity()
+        self.optimize_mean_variance = optimize_mean_variance
+
+    @property
+    def default_parameters(self) -> Dict[str, Any]:
+        # Reference defaults: dmosopt/TRS.py:68-77.
+        return {
+            "nchildren": 1,
+            "success_window_size": 64,
+            "max_population_size": 600,
+            "min_population_size": 100,
+            "adaptive_population_size": False,
+        }
+
+    # ----------------------------------------------------------- host API
+
+    def initialize_strategy(self, x, y, bounds, random=None, **params):
+        self.bounds = np.asarray(bounds, dtype=np.float32)
+        self.local_random = as_generator(random)
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.float32)
+        perm, rank, _ = order_mo(
+            jnp.asarray(x), jnp.asarray(y),
+            x_distance_metrics=self.x_distance_metrics,
+        )
+        perm = np.asarray(perm)
+        rank = np.asarray(rank)
+        P = self.popsize
+        self.state = Struct(
+            bounds=self.bounds,
+            population_parm=x[perm][:P],
+            population_obj=y[perm][:P],
+            rank=rank[:P],
+            tr=TrState(dim=self.nInput),
+            success_window=SlidingWindow(self.opt_params.success_window_size),
+        )
+        return self.state
+
+    def generate(self, **params):
+        P = self.popsize
+        rng = self.local_random
+        xlb, xub = self.bounds[:, 0], self.bounds[:, 1]
+        st = self.state
+
+        population_parm, population_obj = remove_duplicates(
+            st.population_parm, st.population_obj
+        )
+
+        # trust-region boxes around each center (reference TRS.py:118-126)
+        x_centers = population_parm
+        weights = xub - xlb
+        weights = weights / np.mean(weights)
+        weights = weights / np.prod(np.power(weights, 1.0 / len(weights)))
+        tr_lb = np.clip(x_centers - weights * st.tr.length / 2.0, xlb, xub)
+        tr_ub = np.clip(x_centers + weights * st.tr.length / 2.0, xlb, xub)
+
+        pert = sobol(x_centers.shape[0], self.nInput, rng)
+        pert = tr_lb + (tr_ub - tr_lb) * pert
+
+        # perturbation mask: fewer dims at a time in high dimension
+        prob_perturb = min(20.0 / st.tr.dim, 1.0)
+        perturb_mask = rng.random((st.tr.dim,)) <= prob_perturb
+
+        X_cand = x_centers.copy()
+        X_cand[:, perturb_mask] = pert[:, perturb_mask]
+
+        if X_cand.shape[0] < P:
+            sample = sobol(P - X_cand.shape[0], self.nInput, rng)
+            X_cand = np.vstack((X_cand, xlb + (xub - xlb) * sample))
+        return X_cand.astype(np.float32), {}
+
+    generate_strategy = None  # host-loop optimizer
+
+    def update(self, x_gen, y_gen, state=None, **params):
+        st = self.state
+        x_gen = np.asarray(x_gen, np.float32)
+        y_gen = np.asarray(y_gen, np.float32)
+        candidates_x = np.vstack((x_gen, st.population_parm))
+        candidates_y = np.vstack((y_gen, st.population_obj))
+        is_offspring = np.concatenate(
+            (
+                np.ones(x_gen.shape[0], dtype=bool),
+                np.zeros(st.population_parm.shape[0], dtype=bool),
+            )
+        )
+
+        tr = st.tr
+        if tr.restart:
+            self._restart_state()
+
+        chosen, not_chosen, rank = ehvi_front_selection(
+            candidates_y, self.popsize, self.indicator
+        )
+
+        # success-window trust-region control (reference TRS.py:268-292)
+        success_counter = int(np.count_nonzero(is_offspring & chosen))
+        st.success_window.append(success_counter)
+        success_mean = float(np.mean(st.success_window[:]))
+        success_frac = min(1.0, success_mean / self.popsize)
+        if success_frac > tr.success_tolerance:
+            tr.length = min(
+                (1.0 + (success_frac - tr.success_tolerance)) * tr.length,
+                tr.length_max,
+            )
+        elif success_frac <= tr.failure_tolerance:
+            tr.length /= 2.0
+        if tr.length < tr.length_min:
+            tr.restart = True
+
+        st.population_parm = candidates_x[chosen]
+        st.population_obj = candidates_y[chosen]
+        st.rank = rank[chosen]
+        return st
+
+    def _restart_state(self):
+        tr = self.state.tr
+        tr.length = tr.length_init
+        tr.Y_best = np.asarray([np.inf] * tr.dim).reshape((1, -1))
+        tr.restart = False
+        self.state.success_window = SlidingWindow(
+            self.opt_params.success_window_size
+        )
+
+    def get_population_strategy(self, state=None):
+        st = state if state is not None else self.state
+        return st.population_parm.copy(), st.population_obj.copy()
+
+    @property
+    def population_objectives(self):
+        return self.get_population_strategy(self.state)

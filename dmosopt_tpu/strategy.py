@@ -312,8 +312,17 @@ class DistOptStrategy:
         )
         optimizer_index = next(self.optimizer_iter)
         optimizer_kwargs = {}
-        if self.optimizer_kwargs[optimizer_index] is not None:
-            optimizer_kwargs.update(self.optimizer_kwargs[optimizer_index])
+        # a single kwargs dict is shared by all cycled optimizers; any other
+        # length mismatch is a config error, not something to wrap silently
+        if len(self.optimizer_kwargs) not in (1, len(self.optimizer_name)):
+            raise ValueError(
+                f"optimizer_kwargs has {len(self.optimizer_kwargs)} entries "
+                f"for {len(self.optimizer_name)} optimizers; pass one dict "
+                f"or one per optimizer"
+            )
+        okw = self.optimizer_kwargs[optimizer_index % len(self.optimizer_kwargs)]
+        if okw is not None:
+            optimizer_kwargs.update(okw)
         if self.distance_metric is not None:
             optimizer_kwargs["distance_metric"] = self.distance_metric
 

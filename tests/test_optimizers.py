@@ -1,0 +1,150 @@
+"""All-optimizer convergence and integration tests on ZDT1, in the style
+of the reference optimizer-cycling oracle (reference:
+tests/test_zdt1_nsga2_trs.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dmosopt_tpu import sampling
+from dmosopt_tpu.benchmarks.zdt import zdt1, zdt1_pareto, distance_to_front
+from dmosopt_tpu.optimizers import AGEMOEA, CMAES, SMPSO, TRS
+from dmosopt_tpu.optimizers.base import run_ea_loop
+
+DIM = 10
+POP = 48
+BOUNDS = np.stack([np.zeros(DIM), np.ones(DIM)], 1)
+FRONT = zdt1_pareto(400)
+
+
+def _init(n):
+    x = sampling.lh(n, DIM, 42)
+    y = np.asarray(zdt1(jnp.asarray(x)))
+    return x, y
+
+
+def _mean_dist(y):
+    return float(np.mean(distance_to_front(np.asarray(y), FRONT)))
+
+
+def _host_loop(opt, ngen):
+    for _ in range(ngen):
+        xg, st = opt.generate()
+        yg = np.asarray(zdt1(jnp.asarray(np.asarray(xg, np.float32))))
+        opt.update(xg, yg, st)
+    return opt.population_objectives
+
+
+def test_agemoea_improves_and_is_scannable():
+    x0, y0 = _init(POP)
+    opt = AGEMOEA(popsize=POP, nInput=DIM, nOutput=2, model=None)
+    opt.initialize_strategy(x0, y0, BOUNDS, random=1)
+    d0 = _mean_dist(opt.state.population_obj)
+    st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(3), 60, zdt1)
+    d1 = _mean_dist(st.population_obj)
+    assert d1 < d0 * 0.2, (d0, d1)
+    # survival scores: extremes get inf, others finite positive
+    assert np.isinf(np.asarray(st.crowd_dist)).sum() >= 2
+
+
+def test_smpso_improves_and_is_scannable():
+    x0, y0 = _init(POP * 5)  # swarm_size=5 swarms
+    opt = SMPSO(popsize=POP, nInput=DIM, nOutput=2, model=None)
+    opt.initialize_strategy(x0, y0, BOUNDS, random=1)
+    d0 = _mean_dist(opt.state.population_obj.reshape(-1, 2))
+    st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(3), 60, zdt1)
+    d1 = _mean_dist(st.population_obj.reshape(-1, 2))
+    assert d1 < d0 * 0.5, (d0, d1)
+
+
+def test_cmaes_improves():
+    x0, y0 = _init(POP)
+    opt = CMAES(popsize=POP, nInput=DIM, nOutput=2, model=None)
+    opt.initialize_strategy(x0, y0, BOUNDS, random=2)
+    d0 = _mean_dist(opt.state.parents_y)
+    _, y = _host_loop(opt, 40)
+    d1 = _mean_dist(y)
+    assert d1 < d0, (d0, d1)
+    assert opt.state.parents_x.shape == (POP, DIM)
+    # sigma adaptation happened
+    assert not np.allclose(opt.state.sigmas, opt.state.sigmas[0, 0])
+
+
+def test_trs_improves_and_adapts_region():
+    x0, y0 = _init(POP)
+    opt = TRS(popsize=POP, nInput=DIM, nOutput=2, model=None)
+    opt.initialize_strategy(x0, y0, BOUNDS, random=3)
+    d0 = _mean_dist(opt.state.population_obj)
+    _, y = _host_loop(opt, 40)
+    d1 = _mean_dist(y)
+    assert d1 < d0, (d0, d1)
+    # success window drives the trust region; length stays in bounds
+    assert len(opt.state.success_window) == 40
+    assert opt.state.tr.length_min <= opt.state.tr.length <= opt.state.tr.length_max
+
+
+def test_moasmo_epoch_with_each_optimizer():
+    from dmosopt_tpu import moasmo
+
+    names = [f"x{i}" for i in range(DIM)]
+    rng = np.random.default_rng(0)
+    X = rng.uniform(size=(50, DIM)).astype(np.float32)
+    Y = np.asarray(zdt1(jnp.asarray(X)))
+    for name in ("age", "smpso", "cmaes", "trs"):
+        gen = moasmo.epoch(
+            num_generations=5,
+            param_names=names,
+            objective_names=["f1", "f2"],
+            xlb=np.zeros(DIM),
+            xub=np.ones(DIM),
+            pct=0.25,
+            Xinit=X,
+            Yinit=Y,
+            C=None,
+            pop=16,
+            optimizer_name=name,
+            surrogate_method_name="gpr",
+            surrogate_method_kwargs={"n_starts": 2, "n_iter": 20, "seed": 0},
+            local_random=4,
+        )
+        with pytest.raises(StopIteration) as ex:
+            next(gen)
+        res = ex.value.value
+        assert res["x_resample"].shape[0] == 4, name
+        assert np.all(np.isfinite(res["x_resample"])), name
+
+
+def test_optimizer_cycling_nsga2_trs():
+    """The reference's headline cycling config (test_zdt1_nsga2_trs.py)."""
+    import dmosopt_tpu
+
+    def obj(pp):
+        x = np.array([pp[f"x{i}"] for i in range(DIM)])
+        f1 = x[0]
+        g = 1.0 + 9.0 / (DIM - 1) * np.sum(x[1:])
+        return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
+
+    best = dmosopt_tpu.run(
+        {
+            "opt_id": "cycling",
+            "obj_fun": obj,
+            "objective_names": ["f1", "f2"],
+            "space": {f"x{i}": [0.0, 1.0] for i in range(DIM)},
+            "problem_parameters": {},
+            "n_initial": 6,
+            "n_epochs": 4,
+            "population_size": 48,
+            "num_generations": 25,
+            "resample_fraction": 0.5,
+            "optimizer_name": ["nsga2", "trs"],
+            "surrogate_method_name": "gpr",
+            "surrogate_method_kwargs": {"n_starts": 3, "n_iter": 50, "seed": 0},
+            "random_seed": 7,
+        },
+        verbose=False,
+    )
+    prms, lres = best
+    y = np.column_stack([v for _, v in lres])
+    d = distance_to_front(y, FRONT)
+    assert (d < 0.15).sum() >= 8, (len(d), float(np.median(d)))
