@@ -1,0 +1,400 @@
+"""Adaptive termination for high-dimensional multi-objective problems.
+
+Capability match: reference `dmosopt/adaptive_termination.py` —
+`PerObjectiveConvergence` (:48), `MultiScaleStagnationTermination`
+(:158, timescales [5,10,20,40]), `AdaptiveWindowTermination` (:278),
+`CompositeAdaptiveTermination` (:365), `ResourceAwareTermination`
+(:461), and the `create_adaptive_termination` factory (:531) with
+strategies comprehensive/fast/conservative/simple. Wired in by
+`DistOptStrategy` when `termination_conditions` is truthy.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from dmosopt_tpu.hv_termination import HypervolumeProgressTermination
+from dmosopt_tpu.indicators import crowding_distance_metric
+from dmosopt_tpu.termination import (
+    MaximumGenerationTermination,
+    SlidingWindowTermination,
+    Termination,
+    TerminationCollection,
+)
+
+
+@dataclass
+class ConvergenceState:
+    """Per-objective convergence bookkeeping
+    (reference adaptive_termination.py:31-45)."""
+
+    values: deque
+    converged: bool = False
+    stagnation_count: int = 0
+    improvement_rate: float = 0.0
+
+
+class PerObjectiveConvergence(SlidingWindowTermination):
+    """Track each objective's ideal-point progress independently;
+    terminate when a fraction has converged
+    (reference adaptive_termination.py:48-155)."""
+
+    def __init__(
+        self,
+        problem,
+        obj_tol: float = 1e-4,
+        min_converged_fraction: float = 0.8,
+        n_last: int = 20,
+        nth_gen: int = 5,
+        n_max_gen: Optional[int] = None,
+        **kwargs,
+    ):
+        super().__init__(
+            problem,
+            metric_window_size=n_last,
+            data_window_size=2,
+            min_data_for_metric=2,
+            nth_gen=nth_gen,
+            n_max_gen=n_max_gen,
+            **kwargs,
+        )
+        self.n_objectives = problem.n_objectives
+        self.obj_tol = obj_tol
+        self.min_converged_fraction = min_converged_fraction
+        self.objective_states = [
+            ConvergenceState(values=deque(maxlen=n_last))
+            for _ in range(self.n_objectives)
+        ]
+
+    def _store(self, opt):
+        F = np.asarray(opt.y)
+        return {"ideal": F.min(axis=0), "nadir": F.max(axis=0), "F": F}
+
+    def _metric(self, data):
+        last, current = data[-2], data[-1]
+        norm = current["nadir"] - current["ideal"]
+        norm = np.where(norm < 1e-32, 1.0, norm)
+        delta_ideal = np.abs(current["ideal"] - last["ideal"]) / norm
+
+        for i, delta in enumerate(delta_ideal):
+            st = self.objective_states[i]
+            st.values.append(delta)
+            if len(st.values) >= self.metric_window_size:
+                mean_change = float(np.mean(st.values))
+                st.improvement_rate = mean_change
+                if mean_change < self.obj_tol:
+                    st.stagnation_count += 1
+                    if st.stagnation_count >= 3:
+                        st.converged = True
+                else:
+                    st.stagnation_count = 0
+                    st.converged = False
+
+        return {
+            "delta_ideal": delta_ideal,
+            "converged_objectives": sum(s.converged for s in self.objective_states),
+            "mean_improvement": float(
+                np.mean([s.improvement_rate for s in self.objective_states])
+            ),
+        }
+
+    def _decide(self, metrics):
+        latest = metrics[-1]
+        n_converged = latest["converged_objectives"]
+        converged_fraction = n_converged / self.n_objectives
+        if converged_fraction >= self.min_converged_fraction:
+            self._log(
+                f"Optimization terminated: {n_converged}/{self.n_objectives} "
+                f"objectives ({converged_fraction:.1%}) have converged"
+            )
+            return False
+        return True
+
+
+class MultiScaleStagnationTermination(SlidingWindowTermination):
+    """Stagnation detection at multiple timescales simultaneously
+    (reference adaptive_termination.py:158-275)."""
+
+    def __init__(
+        self,
+        problem,
+        timescales: List[int] = (5, 10, 20, 40),
+        stagnation_tol: float = 1e-4,
+        min_scales_stagnant: int = 3,
+        n_max_gen: Optional[int] = None,
+        nth_gen: int = 1,
+        **kwargs,
+    ):
+        timescales = list(timescales)
+        max_scale = max(timescales)
+        super().__init__(
+            problem,
+            metric_window_size=max_scale,
+            data_window_size=max_scale,
+            min_data_for_metric=max_scale,
+            nth_gen=nth_gen,
+            n_max_gen=n_max_gen,
+            **kwargs,
+        )
+        self.timescales = sorted(timescales)
+        self.stagnation_tol = stagnation_tol
+        self.min_scales_stagnant = min_scales_stagnant
+
+    def _store(self, opt):
+        F = np.asarray(opt.y)
+        cd = crowding_distance_metric(F)
+        finite = cd[np.isfinite(cd)]
+        diversity = float(np.mean(finite)) if len(finite) else 0.0
+        return {
+            "ideal": F.min(axis=0),
+            "nadir": F.max(axis=0),
+            "diversity": diversity,
+            "F": F,
+            "X": np.asarray(opt.x),
+        }
+
+    def _metric(self, data):
+        if len(data) < 2:
+            return None
+        current = data[-1]
+        scale_improvements = {}
+        for scale in self.timescales:
+            if len(data) >= scale + 1:
+                past = data[-(scale + 1)]
+                norm = current["nadir"] - current["ideal"]
+                norm = np.where(norm < 1e-32, 1.0, norm)
+                delta_ideal = np.abs(current["ideal"] - past["ideal"]) / norm
+                mean_delta = float(np.mean(delta_ideal))
+                scale_improvements[scale] = {
+                    "ideal_change": mean_delta,
+                    "diversity_change": abs(
+                        current["diversity"] - past["diversity"]
+                    ),
+                    "stagnant": mean_delta < self.stagnation_tol,
+                }
+        return scale_improvements
+
+    def _decide(self, metrics):
+        latest = metrics[-1]
+        if not latest:
+            return True
+        stagnant_scales = [s for s, info in latest.items() if info["stagnant"]]
+        if len(stagnant_scales) >= self.min_scales_stagnant:
+            self._log(
+                f"Optimization terminated: {len(stagnant_scales)}/"
+                f"{len(self.timescales)} timescales show stagnation "
+                f"(scales: {stagnant_scales})"
+            )
+            return False
+        return True
+
+
+class AdaptiveWindowTermination(SlidingWindowTermination):
+    """Window size grows while progress is detected
+    (reference adaptive_termination.py:278-362)."""
+
+    def __init__(
+        self,
+        problem,
+        initial_window: int = 10,
+        max_window: int = 50,
+        expansion_rate: float = 1.2,
+        tol: float = 1e-4,
+        n_max_gen: Optional[int] = None,
+        **kwargs,
+    ):
+        super().__init__(
+            problem,
+            metric_window_size=initial_window,
+            data_window_size=2,
+            min_data_for_metric=2,
+            nth_gen=1,
+            n_max_gen=n_max_gen,
+            **kwargs,
+        )
+        self.initial_window = initial_window
+        self.max_window = max_window
+        self.expansion_rate = expansion_rate
+        self.tol = tol
+        self.current_window_size = initial_window
+
+    def _store(self, opt):
+        F = np.asarray(opt.y)
+        return {"ideal": F.min(axis=0), "nadir": F.max(axis=0)}
+
+    def _metric(self, data):
+        last, current = data[-2], data[-1]
+        norm = current["nadir"] - current["ideal"]
+        norm = np.where(norm < 1e-32, 1.0, norm)
+        delta = float(np.mean(np.abs(current["ideal"] - last["ideal"]) / norm))
+        return {"delta": delta, "window_size": self.current_window_size}
+
+    def _decide(self, metrics):
+        if len(metrics) < self.current_window_size:
+            return True
+        recent = [m["delta"] for m in metrics[-self.current_window_size :]]
+        mean_delta = float(np.mean(recent))
+        if mean_delta > self.tol * 10:
+            new_window = min(
+                int(self.current_window_size * self.expansion_rate), self.max_window
+            )
+            if new_window > self.current_window_size:
+                self.current_window_size = new_window
+                self.metric_window_size = new_window
+        if mean_delta < self.tol:
+            self._log(
+                f"Optimization terminated: mean change {mean_delta:.2e} below "
+                f"tolerance over {self.current_window_size} generations"
+            )
+            return False
+        return True
+
+
+class CompositeAdaptiveTermination(TerminationCollection):
+    """Bundle of adaptive criteria (reference adaptive_termination.py:365-458)."""
+
+    def __init__(
+        self,
+        problem,
+        n_max_gen: int = 2000,
+        obj_tol: float = 1e-4,
+        min_converged_fraction: float = 0.8,
+        hv_tol: float = 1e-5,
+        ref_point: Optional[np.ndarray] = None,
+        timescales: Optional[List[int]] = None,
+        stagnation_tol: float = 1e-4,
+        use_per_objective: bool = True,
+        use_hypervolume: bool = True,
+        use_multiscale: bool = True,
+        **kwargs,
+    ):
+        terminations = [MaximumGenerationTermination(problem, n_max_gen=n_max_gen)]
+        if use_per_objective:
+            terminations.append(
+                PerObjectiveConvergence(
+                    problem=problem,
+                    obj_tol=obj_tol,
+                    min_converged_fraction=min_converged_fraction,
+                    n_last=20,
+                    nth_gen=5,
+                    **kwargs,
+                )
+            )
+        if use_hypervolume:
+            terminations.append(
+                HypervolumeProgressTermination(
+                    problem=problem,
+                    ref_point=ref_point,
+                    hv_tol=hv_tol,
+                    n_last=15,
+                    nth_gen=5,
+                    **kwargs,
+                )
+            )
+        if use_multiscale:
+            if timescales is None:
+                base_scale = max(5, problem.n_objectives // 5)
+                timescales = [base_scale * (2**i) for i in range(4)]
+            terminations.append(
+                MultiScaleStagnationTermination(
+                    problem=problem,
+                    timescales=timescales,
+                    stagnation_tol=stagnation_tol,
+                    min_scales_stagnant=3,
+                    nth_gen=2,
+                    **kwargs,
+                )
+            )
+        super().__init__(problem, *terminations)
+
+
+class ResourceAwareTermination(Termination):
+    """Wall-clock / evaluation / quality budget stop
+    (reference adaptive_termination.py:461-528)."""
+
+    def __init__(
+        self,
+        problem,
+        max_time_seconds: Optional[float] = None,
+        max_function_evals: Optional[int] = None,
+        target_quality_threshold: Optional[float] = None,
+        **kwargs,
+    ):
+        super().__init__(problem)
+        self.max_time_seconds = max_time_seconds
+        self.max_function_evals = max_function_evals
+        self.target_quality_threshold = target_quality_threshold
+        self.start_time = None
+
+    def _do_continue(self, opt):
+        if self.start_time is None:
+            self.start_time = time.time()
+        if self.max_time_seconds is not None:
+            elapsed = time.time() - self.start_time
+            if elapsed > self.max_time_seconds:
+                self._log(
+                    f"Optimization terminated: time limit reached "
+                    f"({elapsed:.1f}s > {self.max_time_seconds:.1f}s)"
+                )
+                return False
+        if self.max_function_evals is not None:
+            n_evals = getattr(
+                opt, "n_eval", getattr(opt, "n_gen", 0)
+            )
+            if n_evals > self.max_function_evals:
+                self._log("Optimization terminated: evaluation limit reached")
+                return False
+        if self.target_quality_threshold is not None:
+            quality = getattr(opt, "quality_metric", None)
+            if quality is not None and quality > self.target_quality_threshold:
+                self._log("Optimization terminated: quality threshold reached")
+                return False
+        return True
+
+
+def create_adaptive_termination(
+    problem, n_max_gen: int = 2000, strategy: str = "comprehensive", **kwargs
+) -> Termination:
+    """Factory (reference adaptive_termination.py:531-612):
+    comprehensive | fast | conservative | simple."""
+    if strategy == "comprehensive":
+        return CompositeAdaptiveTermination(
+            problem=problem,
+            n_max_gen=n_max_gen,
+            use_per_objective=True,
+            use_hypervolume=True,
+            use_multiscale=True,
+            hv_tol=kwargs.pop("hv_tol", 1e-6),
+            **kwargs,
+        )
+    if strategy == "fast":
+        return CompositeAdaptiveTermination(
+            problem=problem,
+            n_max_gen=n_max_gen,
+            use_per_objective=False,
+            use_hypervolume=True,
+            use_multiscale=True,
+            **kwargs,
+        )
+    if strategy == "conservative":
+        return CompositeAdaptiveTermination(
+            problem=problem,
+            n_max_gen=n_max_gen,
+            use_per_objective=True,
+            use_hypervolume=False,
+            use_multiscale=True,
+            **kwargs,
+        )
+    if strategy == "simple":
+        return HypervolumeProgressTermination(
+            problem=problem, n_last=20, nth_gen=5, n_max_gen=n_max_gen, **kwargs
+        )
+    raise ValueError(
+        f"Unknown strategy {strategy!r}. Choose from: 'comprehensive', "
+        f"'fast', 'conservative', 'simple'"
+    )

@@ -1,0 +1,304 @@
+"""Hypervolume-progress termination with multi-fidelity tracking.
+
+Capability match: reference `dmosopt/hv_termination.py` —
+`ProgressivePrecisionScheduler` (:90, coarse->fine epsilon by
+generation), `HVAlgorithmRouter` (:225, dimension-based algorithm
+choice), `MultiFidelityHVTracker` (:446, coarse/medium/fine cadences
+1/5/10), `ConvergenceDetector` (:684, stagnation + confidence), and
+`HypervolumeProgressTermination` (:960) with adaptive reference point.
+
+TPU redesign: every hypervolume evaluation goes through
+`dmosopt_tpu.hv.AdaptiveHyperVolume` (exact for low d, jitted
+Monte Carlo above), and fidelity epsilons map to MC sample counts
+(samples ~ 1/eps^2) instead of the reference's per-algorithm epsilon
+plumbing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from dmosopt_tpu.hv import AdaptiveHyperVolume
+from dmosopt_tpu.termination import SlidingWindowTermination
+
+
+class ProgressivePrecisionScheduler:
+    """Coarse-to-fine precision by generation phase
+    (reference hv_termination.py:90-222)."""
+
+    def __init__(
+        self,
+        early_threshold: int = 20,
+        mid_threshold: int = 50,
+        early_epsilon: float = 0.05,
+        mid_epsilon: float = 0.02,
+        late_epsilon: float = 0.01,
+    ):
+        self.early_threshold = early_threshold
+        self.mid_threshold = mid_threshold
+        self.early_epsilon = early_epsilon
+        self.mid_epsilon = mid_epsilon
+        self.late_epsilon = late_epsilon
+
+    def get_epsilon(self, generation: int) -> float:
+        if generation < self.early_threshold:
+            return self.early_epsilon
+        if generation < self.mid_threshold:
+            return self.mid_epsilon
+        return self.late_epsilon
+
+    def get_phase(self, generation: int) -> str:
+        if generation < self.early_threshold:
+            return "early"
+        if generation < self.mid_threshold:
+            return "mid"
+        return "late"
+
+
+def _samples_for_epsilon(eps: float) -> int:
+    """MC sample count giving ~eps relative standard error (var ~ 1/S)."""
+    return int(np.clip(4.0 / (eps * eps), 2_000, 1_000_000))
+
+
+class HVAlgorithmRouter:
+    """Dimension-based algorithm choice (reference hv_termination.py:225-443):
+    exact below the dimension threshold, Monte Carlo above, with the MC
+    sample count derived from the requested epsilon."""
+
+    def __init__(self, exact_dim_threshold: int = 10):
+        self.exact_dim_threshold = exact_dim_threshold
+
+    def compute(self, F: np.ndarray, ref_point: np.ndarray, epsilon: float) -> float:
+        hv = AdaptiveHyperVolume(
+            ref_point,
+            exact_dim_threshold=self.exact_dim_threshold,
+            mc_samples=_samples_for_epsilon(epsilon),
+        )
+        return hv.compute_hypervolume(F)
+
+
+@dataclass
+class _Estimate:
+    value: float
+    generation: int
+    fidelity: str
+
+
+@dataclass
+class _TrackerState:
+    history_coarse: List[float] = field(default_factory=list)
+    history_medium: List[float] = field(default_factory=list)
+    history_fine: List[float] = field(default_factory=list)
+    estimates: List[_Estimate] = field(default_factory=list)
+
+
+class MultiFidelityHVTracker:
+    """Coarse/medium/fine cadence HV tracking
+    (reference hv_termination.py:446-681)."""
+
+    def __init__(
+        self,
+        reference_point: np.ndarray,
+        coarse_epsilon: float = 0.05,
+        medium_epsilon: float = 0.02,
+        fine_epsilon: float = 0.01,
+        coarse_freq: int = 1,
+        medium_freq: int = 5,
+        fine_freq: int = 10,
+    ):
+        self.reference_point = np.asarray(reference_point, dtype=np.float64)
+        self.epsilons = {
+            "coarse": coarse_epsilon,
+            "medium": medium_epsilon,
+            "fine": fine_epsilon,
+        }
+        self.freqs = {
+            "coarse": coarse_freq,
+            "medium": medium_freq,
+            "fine": fine_freq,
+        }
+        self.router = HVAlgorithmRouter()
+        self.state = _TrackerState()
+
+    def compute_and_update(
+        self, F: np.ndarray, generation: int, minimize: bool = True, verbose=False
+    ):
+        for fidelity in ("coarse", "medium", "fine"):
+            if generation % self.freqs[fidelity] == 0:
+                value = self.router.compute(
+                    F, self.reference_point, self.epsilons[fidelity]
+                )
+                getattr(self.state, f"history_{fidelity}").append(value)
+                self.state.estimates.append(_Estimate(value, generation, fidelity))
+
+    def get_best_estimate(
+        self, generation: int, max_age: int = 10
+    ) -> Optional[_Estimate]:
+        """Freshest highest-fidelity estimate within `max_age` generations."""
+        best = None
+        order = {"fine": 2, "medium": 1, "coarse": 0}
+        for est in reversed(self.state.estimates):
+            if generation - est.generation > max_age:
+                break
+            if best is None or order[est.fidelity] > order[best.fidelity]:
+                best = est
+        return best
+
+
+@dataclass
+class ConvergenceResult:
+    converged: bool
+    confidence: float
+    primary_reason: str
+
+
+class ConvergenceDetector:
+    """Stagnation + confidence scoring (reference hv_termination.py:684-957)."""
+
+    def __init__(
+        self,
+        stagnation_threshold: float = 1e-5,
+        stagnation_window: int = 5,
+        relative_threshold: float = 1e-6,
+        min_generations: int = 20,
+    ):
+        self.stagnation_threshold = stagnation_threshold
+        self.stagnation_window = stagnation_window
+        self.relative_threshold = relative_threshold
+        self.min_generations = min_generations
+
+    def check_convergence(
+        self, tracker: MultiFidelityHVTracker, generation: int, F, verbose=False
+    ) -> ConvergenceResult:
+        history = tracker.state.history_coarse
+        if generation < self.min_generations or len(history) < self.stagnation_window + 1:
+            return ConvergenceResult(False, 0.0, "insufficient history")
+
+        window = np.asarray(history[-(self.stagnation_window + 1) :])
+        deltas = np.abs(np.diff(window))
+        rel = deltas / (np.abs(window[:-1]) + 1e-10)
+
+        checks = {
+            "absolute stagnation": bool(np.all(deltas < self.stagnation_threshold)),
+            "relative stagnation": bool(np.all(rel < self.relative_threshold * 10)),
+            "monotone plateau": bool(np.max(window) - np.min(window)
+                                     < self.stagnation_threshold * self.stagnation_window),
+        }
+        confidence = sum(checks.values()) / len(checks)
+        converged = checks["absolute stagnation"] and confidence >= 2 / 3
+        reason = (
+            ", ".join(k for k, v in checks.items() if v) if converged else "progressing"
+        )
+        return ConvergenceResult(converged, confidence, reason)
+
+
+class HypervolumeProgressTermination(SlidingWindowTermination):
+    """Adaptive HV-progress termination
+    (reference hv_termination.py:960-1160)."""
+
+    def __init__(
+        self,
+        problem,
+        ref_point: Optional[np.ndarray] = None,
+        hv_tol: float = 1e-5,
+        n_last: int = 15,
+        nth_gen: int = 5,
+        n_max_gen: Optional[int] = None,
+        adaptive_ref_point: bool = True,
+        min_generations: int = 20,
+        verbose: bool = False,
+        **kwargs,
+    ):
+        super().__init__(
+            problem,
+            metric_window_size=n_last,
+            data_window_size=2,
+            min_data_for_metric=2,
+            nth_gen=nth_gen,
+            n_max_gen=n_max_gen,
+            **kwargs,
+        )
+        self.ref_point = np.copy(ref_point) if ref_point is not None else None
+        self.hv_tol = hv_tol
+        self.adaptive_ref_point = adaptive_ref_point
+        self.verbose = verbose
+        self._precision_scheduler = None
+        self._mf_tracker = None
+        self._convergence_detector = None
+        self._convergence_detector_config = {
+            "stagnation_threshold": hv_tol,
+            "stagnation_window": min(n_last, 5),
+            "relative_threshold": hv_tol / 10,
+            "min_generations": min_generations,
+        }
+
+    def _adapt_ref_point(self, F):
+        margin = 0.1
+        worst = F.max(axis=0)
+        best = F.min(axis=0)
+        return worst + margin * np.abs(worst - best)
+
+    def _initialize_components(self, F):
+        if self._mf_tracker is not None:
+            return
+        if self.ref_point is None or self.adaptive_ref_point:
+            self.ref_point = self._adapt_ref_point(F)
+        self._precision_scheduler = ProgressivePrecisionScheduler()
+        self._mf_tracker = MultiFidelityHVTracker(reference_point=self.ref_point)
+        self._convergence_detector = ConvergenceDetector(
+            **self._convergence_detector_config
+        )
+
+    def _store(self, opt):
+        F = np.asarray(opt.y)
+        self._initialize_components(F)
+        if self.adaptive_ref_point:
+            self.ref_point = self._adapt_ref_point(F)
+            self._mf_tracker.reference_point = self.ref_point
+        return {"F": F, "ref_point": self.ref_point.copy()}
+
+    def _metric(self, data):
+        current = data[-1]
+        F_current = current["F"]
+        generation = len(self._mf_tracker.state.history_coarse)
+        self._mf_tracker.compute_and_update(
+            F_current, generation, minimize=True, verbose=self.verbose
+        )
+        best_estimate = self._mf_tracker.get_best_estimate(generation, max_age=10)
+        hv_current = best_estimate.value if best_estimate else 0.0
+        history = self._mf_tracker.state.history_coarse
+        if len(history) >= 2:
+            hv_improvement = history[-1] - history[-2]
+            relative_improvement = hv_improvement / (history[-2] + 1e-10)
+        else:
+            hv_improvement, relative_improvement = 0.0, 0.0
+        result = self._convergence_detector.check_convergence(
+            self._mf_tracker, generation, F_current, verbose=self.verbose
+        )
+        return {
+            "hv": hv_current,
+            "hv_improvement": hv_improvement,
+            "relative_improvement": relative_improvement,
+            "converged": result.converged,
+            "confidence": result.confidence,
+            "reason": result.primary_reason,
+        }
+
+    def _decide(self, metrics):
+        if len(metrics) < 3:
+            return True
+        latest = metrics[-1]
+        if latest["converged"]:
+            self._log(
+                f"Hypervolume convergence detected: final HV {latest['hv']:.6f}, "
+                f"confidence {latest['confidence']:.2%}, reason: {latest['reason']}"
+            )
+            return False
+        self._log(
+            f"HV progress - current: {latest['hv']:.6f}, "
+            f"improvement: {latest['relative_improvement']:.2e}"
+        )
+        return True

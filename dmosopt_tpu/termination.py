@@ -1,0 +1,267 @@
+"""Classic termination criteria (reference: dmosopt/termination.py,
+pymoo-derived).
+
+These are host-side controllers reading population metrics; with the
+on-device generation loop they are consulted every
+`termination_check_interval` generations (see moasmo._optimize_on_device)
+instead of every generation, amortizing the device->host sync.
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+
+import numpy as np
+
+from dmosopt_tpu.indicators import IGD, SlidingWindow
+from dmosopt_tpu.normalization import normalize
+
+
+class Termination:
+    """Base criterion (reference termination.py:14-59)."""
+
+    def __init__(self, problem) -> None:
+        self.problem = problem
+        self.force_termination = False
+
+    def do_continue(self, opt):
+        if self.force_termination:
+            return False
+        return self._do_continue(opt)
+
+    def _do_continue(self, opt, **kwargs):  # pragma: no cover
+        return True
+
+    def has_terminated(self, opt):
+        return not self.do_continue(opt)
+
+    def _log(self, msg):
+        logger = getattr(self.problem, "logger", None)
+        if logger is not None:
+            logger.info(msg)
+
+
+class TerminationCollection(Termination):
+    """Terminate when ANY member terminates (reference termination.py:61-69)."""
+
+    def __init__(self, problem, *args) -> None:
+        super().__init__(problem)
+        self.terminations = args
+
+    def _do_continue(self, opt):
+        return all(term.do_continue(opt) for term in self.terminations)
+
+
+class MaximumGenerationTermination(Termination):
+    def __init__(self, problem, n_max_gen) -> None:
+        super().__init__(problem)
+        self.n_max_gen = float("inf") if n_max_gen is None else n_max_gen
+
+    def _do_continue(self, opt):
+        if opt.n_gen > self.n_max_gen:
+            self._log(
+                f"Optimization terminated: maximum number of generations "
+                f"({opt.n_gen}) has been reached"
+            )
+        return opt.n_gen <= self.n_max_gen
+
+
+class SlidingWindowTermination(TerminationCollection):
+    """Metric-over-window framework (reference termination.py:90-190)."""
+
+    def __init__(
+        self,
+        problem,
+        metric_window_size=None,
+        data_window_size=None,
+        min_data_for_metric=1,
+        nth_gen=1,
+        n_max_gen=None,
+        truncate_metrics=True,
+        truncate_data=True,
+    ):
+        super().__init__(
+            problem, MaximumGenerationTermination(problem, n_max_gen=n_max_gen)
+        )
+        self.data_window_size = data_window_size
+        self.metric_window_size = metric_window_size
+        self.truncate_data = truncate_data
+        self.data = SlidingWindow(data_window_size) if truncate_data else []
+        self.truncate_metrics = truncate_metrics
+        self.metrics = SlidingWindow(metric_window_size) if truncate_metrics else []
+        self.nth_gen = nth_gen
+        self.min_data_for_metric = min_data_for_metric
+
+    def reset(self):
+        self.data = SlidingWindow(self.data_window_size) if self.truncate_data else []
+        self.metrics = (
+            SlidingWindow(self.metric_window_size) if self.truncate_metrics else []
+        )
+
+    def _do_continue(self, opt):
+        if not super()._do_continue(opt):
+            return False
+        obj = self._store(opt)
+        if obj is not None:
+            self.data.append(obj)
+        if len(self.data) >= self.min_data_for_metric:
+            metric = self._metric(self.data[-self.data_window_size :])
+            if metric is not None:
+                self.metrics.append(metric)
+        if (
+            opt.n_gen % self.nth_gen == 0
+            and len(self.metrics) >= self.metric_window_size
+        ):
+            return self._decide(self.metrics[-self.metric_window_size :])
+        return True
+
+    def _store(self, opt):
+        return opt
+
+    @abstractmethod
+    def _decide(self, metrics):  # pragma: no cover
+        ...
+
+    @abstractmethod
+    def _metric(self, data):  # pragma: no cover
+        ...
+
+    def get_metric(self):
+        return self.metrics[-1] if self.metrics else None
+
+
+class ParameterToleranceTermination(SlidingWindowTermination):
+    """IGD of consecutive normalized parameter populations below tol
+    (reference termination.py:193-231)."""
+
+    def __init__(self, problem, n_last=10, tol=1e-6, nth_gen=1, n_max_gen=None, **kw):
+        super().__init__(
+            problem,
+            metric_window_size=n_last,
+            data_window_size=2,
+            min_data_for_metric=2,
+            nth_gen=nth_gen,
+            n_max_gen=n_max_gen,
+            **kw,
+        )
+        self.tol = tol
+
+    def _store(self, opt):
+        X = opt.x
+        if X.dtype != object:
+            lb = getattr(self.problem, "lb", None)
+            ub = getattr(self.problem, "ub", None)
+            if lb is not None and ub is not None:
+                X = normalize(X, xl=lb, xu=ub)
+            return X
+        return None
+
+    def _metric(self, data):
+        last, current = data[-2], data[-1]
+        return IGD(current).do(last)
+
+    def _decide(self, metrics):
+        metrics_mean = float(np.asarray(metrics).mean())
+        if metrics_mean <= self.tol:
+            self._log(
+                f"Optimization terminated: mean parameter distance "
+                f"{metrics_mean} is below tolerance {self.tol}"
+            )
+        return metrics_mean > self.tol
+
+
+def calc_delta_norm(a, b, norm):
+    return np.max(np.abs((a - b) / norm))
+
+
+class MultiObjectiveToleranceTermination(SlidingWindowTermination):
+    """Ideal/nadir delta + population IGD below tol
+    (reference termination.py:234-292)."""
+
+    def __init__(self, problem, tol=0.0025, n_last=10, nth_gen=1, n_max_gen=None, **kw):
+        super().__init__(
+            problem,
+            metric_window_size=n_last,
+            data_window_size=2,
+            min_data_for_metric=2,
+            nth_gen=nth_gen,
+            n_max_gen=n_max_gen,
+            **kw,
+        )
+        self.tol = tol
+
+    def _store(self, opt):
+        F = np.asarray(opt.y)
+        return {"ideal": F.min(axis=0), "nadir": F.max(axis=0), "F": F}
+
+    def _metric(self, data):
+        last, current = data[-2], data[-1]
+        norm = current["nadir"] - current["ideal"]
+        norm = np.where(norm < 1e-32, 1.0, norm)
+        delta_ideal = calc_delta_norm(current["ideal"], last["ideal"], norm)
+        c_F, c_ideal, c_nadir = current["F"], current["ideal"], current["nadir"]
+        c_N = normalize(c_F, c_ideal, c_nadir)
+        l_N = normalize(last["F"], c_ideal, c_nadir)
+        delta_f = IGD(c_N).do(l_N)
+        return {"delta_ideal": delta_ideal, "delta_f": delta_f}
+
+    def _decide(self, metrics):
+        delta_ideal = np.mean([e["delta_ideal"] for e in metrics])
+        delta_f = np.mean([e["delta_f"] for e in metrics])
+        max_delta = max(delta_ideal, delta_f)
+        if max_delta <= self.tol:
+            self._log(
+                f"Optimization terminated: convergence of objective mean "
+                f"delta {(delta_ideal, delta_f)} is below tolerance {self.tol}"
+            )
+        return max_delta > self.tol
+
+
+class ConstraintViolationToleranceTermination(SlidingWindowTermination):
+    """Constraint-violation change below tol while infeasible
+    (reference termination.py:295-330)."""
+
+    def __init__(self, problem, n_last=10, tol=1e-6, nth_gen=1, n_max_gen=None, **kw):
+        super().__init__(
+            problem,
+            metric_window_size=n_last,
+            data_window_size=2,
+            min_data_for_metric=2,
+            nth_gen=nth_gen,
+            n_max_gen=n_max_gen,
+            **kw,
+        )
+        self.tol = tol
+
+    def _store(self, opt):
+        return opt.c
+
+    def _metric(self, data):
+        last, current = data[-2], data[-1]
+        return {"cv": current, "delta_cv": abs(last - current)}
+
+    def _decide(self, metrics):
+        cv = np.asarray([e["cv"] for e in metrics])
+        delta_cv = np.asarray([e["delta_cv"] for e in metrics])
+        n_feasible = (cv > 0).sum()
+        if n_feasible == len(metrics):
+            return False
+        if 0 < n_feasible < len(metrics):
+            return True
+        return delta_cv.max() > self.tol
+
+
+class StandardTermination(TerminationCollection):
+    """Default multi-criterion bundle: objective tolerance + parameter
+    tolerance + max generations."""
+
+    def __init__(self, problem, x_tol=1e-8, f_tol=0.0025, n_last=10, n_max_gen=None):
+        super().__init__(
+            problem,
+            ParameterToleranceTermination(
+                problem, tol=x_tol, n_last=n_last, n_max_gen=n_max_gen
+            ),
+            MultiObjectiveToleranceTermination(
+                problem, tol=f_tol, n_last=n_last, n_max_gen=n_max_gen
+            ),
+        )

@@ -1,0 +1,200 @@
+"""Termination criteria tests (reference semantics: dmosopt/termination.py,
+adaptive_termination.py, hv_termination.py)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from dmosopt_tpu.adaptive_termination import (
+    CompositeAdaptiveTermination,
+    MultiScaleStagnationTermination,
+    PerObjectiveConvergence,
+    ResourceAwareTermination,
+    create_adaptive_termination,
+)
+from dmosopt_tpu.datatypes import OptHistory
+from dmosopt_tpu.hv_termination import (
+    HypervolumeProgressTermination,
+    MultiFidelityHVTracker,
+    ProgressivePrecisionScheduler,
+)
+from dmosopt_tpu.termination import (
+    MaximumGenerationTermination,
+    MultiObjectiveToleranceTermination,
+    ParameterToleranceTermination,
+    StandardTermination,
+    TerminationCollection,
+)
+
+
+class Prob:
+    n_objectives = 2
+    lb = np.zeros(4)
+    ub = np.ones(4)
+    logger = logging.getLogger("term-test")
+
+
+def _opt(n_gen, x, y, c=None):
+    return OptHistory(n_gen, n_gen * len(x), np.asarray(x), np.asarray(y), c)
+
+
+def _static_history(n=60, n_pts=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(size=(n_pts, 4))
+    y = rng.uniform(size=(n_pts, 2))
+    return [(i + 1, x, y) for i in range(n)]
+
+
+def test_max_generation():
+    t = MaximumGenerationTermination(Prob(), 10)
+    x = np.zeros((4, 4))
+    y = np.zeros((4, 2))
+    assert not t.has_terminated(_opt(10, x, y))
+    assert t.has_terminated(_opt(11, x, y))
+
+
+def test_moo_tolerance_terminates_on_static_population():
+    t = MultiObjectiveToleranceTermination(Prob(), tol=0.0025, n_last=5)
+    terminated = False
+    for i, x, y in _static_history():
+        if t.has_terminated(_opt(i, x, y)):
+            terminated = True
+            break
+    assert terminated
+
+
+def test_moo_tolerance_continues_on_moving_population():
+    t = MultiObjectiveToleranceTermination(Prob(), tol=1e-6, n_last=5)
+    rng = np.random.default_rng(1)
+    x = rng.uniform(size=(16, 4))
+    for i in range(30):
+        y = rng.uniform(size=(16, 2)) - 0.5 * i  # ideal keeps improving
+        if t.has_terminated(_opt(i + 1, x, y)):
+            pytest.fail("terminated on a steadily improving population")
+
+
+def test_parameter_tolerance():
+    t = ParameterToleranceTermination(Prob(), tol=1e-6, n_last=3)
+    terminated = False
+    for i, x, y in _static_history(30):
+        if t.has_terminated(_opt(i, x, y)):
+            terminated = True
+            break
+    assert terminated
+
+
+def test_standard_and_collection():
+    t = StandardTermination(Prob(), n_max_gen=100)
+    assert isinstance(t, TerminationCollection)
+    terminated = False
+    for i, x, y in _static_history(60):
+        if t.has_terminated(_opt(i, x, y)):
+            terminated = True
+            break
+    assert terminated
+
+
+def test_per_objective_convergence():
+    t = PerObjectiveConvergence(Prob(), obj_tol=1e-3, n_last=5, nth_gen=1)
+    terminated = False
+    for i, x, y in _static_history(60):
+        if t.has_terminated(_opt(i, x, y)):
+            terminated = True
+            break
+    assert terminated
+
+
+def test_multiscale_stagnation():
+    t = MultiScaleStagnationTermination(
+        Prob(), timescales=[3, 5, 8, 12], stagnation_tol=1e-3, nth_gen=1
+    )
+    terminated = False
+    for i, x, y in _static_history(60):
+        if t.has_terminated(_opt(i, x, y)):
+            terminated = True
+            break
+    assert terminated
+
+
+def test_hv_progress_termination_on_static_front():
+    t = HypervolumeProgressTermination(
+        Prob(), hv_tol=1e-4, n_last=4, nth_gen=1, min_generations=5
+    )
+    terminated = False
+    for i, x, y in _static_history(80):
+        if t.has_terminated(_opt(i, x, y)):
+            terminated = True
+            break
+    assert terminated
+
+
+def test_precision_scheduler_and_tracker():
+    s = ProgressivePrecisionScheduler()
+    assert s.get_epsilon(0) > s.get_epsilon(100)
+    assert s.get_phase(0) == "early" and s.get_phase(100) == "late"
+
+    tracker = MultiFidelityHVTracker(np.array([2.0, 2.0]))
+    F = np.array([[1.0, 1.0], [0.5, 1.5]])
+    for gen in range(11):
+        tracker.compute_and_update(F, gen)
+    assert len(tracker.state.history_coarse) == 11
+    assert len(tracker.state.history_medium) == 3  # gens 0, 5, 10
+    best = tracker.get_best_estimate(10)
+    assert best is not None and best.fidelity == "fine"
+
+
+def test_composite_and_factory():
+    for strategy in ("comprehensive", "fast", "conservative", "simple"):
+        t = create_adaptive_termination(Prob(), n_max_gen=50, strategy=strategy)
+        assert t is not None
+    with pytest.raises(ValueError):
+        create_adaptive_termination(Prob(), strategy="bogus")
+
+    t = CompositeAdaptiveTermination(Prob(), n_max_gen=30)
+    x = np.zeros((4, 4))
+    y = np.zeros((4, 2))
+    assert t.has_terminated(_opt(31, x, y))  # max-gen member fires
+
+
+def test_resource_aware():
+    t = ResourceAwareTermination(Prob(), max_function_evals=100)
+    x = np.zeros((4, 4))
+    y = np.zeros((4, 2))
+    assert not t.has_terminated(_opt(10, x, y))
+    assert t.has_terminated(_opt(50, x, y))  # n_eval = 50*4 = 200 > 100
+
+
+def test_termination_in_moasmo_surrogate_loop():
+    """End-to-end: adaptive termination stops the on-device EA early."""
+    import jax.numpy as jnp
+
+    from dmosopt_tpu import moasmo
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+
+    rng = np.random.default_rng(5)
+    X = rng.uniform(size=(40, 4)).astype(np.float32)
+    Y = np.asarray(zdt1(jnp.asarray(X)))
+
+    t = MultiObjectiveToleranceTermination(Prob(), tol=0.05, n_last=3, n_max_gen=500)
+    gen = moasmo.epoch(
+        num_generations=10,  # ignored: termination is the stopping rule
+        param_names=[f"x{i}" for i in range(4)],
+        objective_names=["f1", "f2"],
+        xlb=np.zeros(4),
+        xub=np.ones(4),
+        pct=0.5,
+        Xinit=X,
+        Yinit=Y,
+        C=None,
+        pop=16,
+        optimizer_name="nsga2",
+        surrogate_method_name="gpr",
+        surrogate_method_kwargs={"n_starts": 2, "n_iter": 20, "seed": 0},
+        termination=t,
+        local_random=3,
+    )
+    with pytest.raises(StopIteration) as ex:
+        next(gen)
+    res = ex.value.value
+    assert res["x_resample"].shape[0] == 8
