@@ -1,0 +1,152 @@
+"""Feasibility models: constraint-satisfaction classifiers.
+
+Capability match: reference `dmosopt/feasibility.py` —
+`LogisticFeasibilityModel`: one binary classifier per constraint
+(feasible iff c > 0), `predict`/`predict_proba`, and `rank(x)` = mean
+feasible probability, used as an x-distance metric by every optimizer.
+
+TPU redesign: the reference grid-searches sklearn pipelines
+(PCA -> scaler -> L1 logistic, GridSearchCV) per constraint in Python.
+Here every constraint is fit in ONE jitted program: inputs are
+standardized and PCA-rotated (SVD whitening), and an L1-regularized
+logistic regression is trained by proximal gradient descent for a GRID
+of regularization strengths simultaneously (vmap over lambda x
+constraints), with k-fold cross-validation accuracy (also vmapped)
+selecting the strength — the analog of the reference's GridSearchCV.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_LAMBDAS = jnp.logspace(-4, 4, 4)  # reference grid: np.logspace(-4, 4, 4) on C
+_N_FOLDS = 3
+_N_STEPS = 300
+
+
+def _soft_threshold(w, t):
+    return jnp.sign(w) * jnp.maximum(jnp.abs(w) - t, 0.0)
+
+
+def _fit_logistic_l1(X, y, mask, lam, n_steps=_N_STEPS, lr=0.1):
+    """Proximal gradient descent on masked logistic loss with L1 penalty
+    ``lam * |w|`` (sklearn's C = 1/lam up to scaling). Returns (w, b)."""
+    n, d = X.shape
+
+    def step(carry, _):
+        w, b = carry
+        logits = X @ w + b
+        p = jax.nn.sigmoid(logits)
+        g = (p - y) * mask
+        denom = jnp.maximum(mask.sum(), 1.0)
+        gw = X.T @ g / denom
+        gb = g.sum() / denom
+        w = _soft_threshold(w - lr * gw, lr * lam / denom)
+        b = b - lr * gb
+        return (w, b), None
+
+    (w, b), _ = jax.lax.scan(
+        step, (jnp.zeros((d,)), jnp.zeros(())), None, length=n_steps
+    )
+    return w, b
+
+
+@partial(jax.jit, static_argnames=("n_folds",))
+def _fit_constraint(X, y, key, n_folds=_N_FOLDS):
+    """Fit one constraint classifier: CV-select lambda, refit on all data.
+    Returns (w, b, cv_scores)."""
+    n, d = X.shape
+    fold = jax.random.permutation(key, n) % n_folds
+
+    def fit_eval(lam, k):
+        train = fold != k
+        w, b = _fit_logistic_l1(X, y, train.astype(X.dtype), lam)
+        pred = (X @ w + b) > 0
+        correct = (pred == (y > 0.5)) & ~train
+        return correct.sum() / jnp.maximum((~train).sum(), 1)
+
+    scores = jax.vmap(
+        lambda lam: jnp.mean(
+            jax.vmap(lambda k: fit_eval(lam, k))(jnp.arange(n_folds))
+        )
+    )(_LAMBDAS)
+    best = jnp.argmax(scores)
+    w, b = _fit_logistic_l1(X, y, jnp.ones((n,), X.dtype), _LAMBDAS[best])
+    return w, b, scores
+
+
+class LogisticFeasibilityModel:
+    """Per-constraint L1 logistic feasibility classifier
+    (reference: dmosopt/feasibility.py:14-67)."""
+
+    def __init__(self, X, C, seed: Optional[int] = 0):
+        X = np.asarray(X, dtype=np.float64)
+        C = np.asarray(C, dtype=np.float64)
+        if C.ndim == 1:
+            C = C.reshape(-1, 1)
+        self.n_constraints = C.shape[1]
+        self.X = X
+
+        # standardize + PCA rotation (shared by all constraints)
+        self.x_mean = X.mean(axis=0)
+        self.x_std = np.where(X.std(axis=0) == 0.0, 1.0, X.std(axis=0))
+        Z = (X - self.x_mean) / self.x_std
+        _, _, Vt = np.linalg.svd(Z, full_matrices=False)
+        self.rotation = Vt.T  # (d, k)
+        Zr = Z @ self.rotation
+
+        self.weights = []  # per-constraint (w, b) or None (single-class)
+        key = jax.random.PRNGKey(seed or 0)
+        for i in range(self.n_constraints):
+            c_i = (C[:, i] > 0.0).astype(np.float64)
+            if len(np.unique(c_i)) <= 1:
+                self.weights.append(None)
+                continue
+            key, k = jax.random.split(key)
+            w, b, _ = _fit_constraint(
+                jnp.asarray(Zr, jnp.float32), jnp.asarray(c_i, jnp.float32), k
+            )
+            self.weights.append((np.asarray(w), float(b)))
+
+        # stacked jax parameters so rank()/predict are traceable and can run
+        # inside jitted EA steps (single-class constraints get w=0, b>>0 so
+        # their feasibility probability is ~1)
+        k_dim = self.rotation.shape[1]
+        Wm = np.zeros((self.n_constraints, k_dim))
+        bv = np.full((self.n_constraints,), 30.0)
+        for i, wb in enumerate(self.weights):
+            if wb is not None:
+                Wm[i] = wb[0]
+                bv[i] = wb[1]
+        self._W = jnp.asarray(Wm, jnp.float32)
+        self._b = jnp.asarray(bv, jnp.float32)
+        self._jx_mean = jnp.asarray(self.x_mean, jnp.float32)
+        self._jx_std = jnp.asarray(self.x_std, jnp.float32)
+        self._jrot = jnp.asarray(self.rotation, jnp.float32)
+
+    def _proba_feasible(self, x) -> jax.Array:
+        """(n_constraints, N) probability of feasibility; jax-traceable."""
+        x = jnp.atleast_2d(jnp.asarray(x, jnp.float32))
+        Z = ((x - self._jx_mean) / self._jx_std) @ self._jrot
+        return jax.nn.sigmoid(Z @ self._W.T + self._b).T
+
+    def predict(self, x) -> np.ndarray:
+        """(N, n_constraints) hard feasibility predictions."""
+        return np.asarray(self._proba_feasible(x) > 0.5).astype(int).T
+
+    def predict_proba(self, x) -> np.ndarray:
+        """(n_constraints, N, 2) class probabilities, sklearn layout
+        (column 1 = feasible)."""
+        p = np.asarray(self._proba_feasible(x))
+        return np.stack([1.0 - p, p], axis=-1)
+
+    def rank(self, x) -> jax.Array:
+        """Mean feasible probability per point (reference :64-67) — used as
+        an x-distance metric in the optimizers; jax-traceable so it can run
+        inside the scanned generation loop."""
+        return self._proba_feasible(x).mean(axis=0)

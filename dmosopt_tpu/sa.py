@@ -1,0 +1,149 @@
+"""Sensitivity analysis: FAST and DGSM, self-contained (no SALib).
+
+Capability match: reference `dmosopt/sa.py` — `SA_FAST` (:11) and
+`SA_DGSM` (:47): sample the input box, evaluate the *surrogate* on the
+samples, return first-order sensitivity indices `S1` per objective.
+MOASMO maps max-normalized S1 to per-dimension di_mutation/di_crossover
+(reference MOASMO.py:535-578).
+
+TPU redesign: the reference shells out to SALib (host C/NumPy). Here
+both methods are implemented directly — the FAST search curves, Fourier
+spectra, and DGSM finite-difference derivative statistics are plain
+array math, evaluated in one batched surrogate call (the GP predict is
+a jitted TPU kernel), with the spectrum reduction vectorized over
+objectives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+_M_HARMONICS = 4  # interference factor, standard FAST choice
+
+
+class SA_FAST:
+    """Fourier Amplitude Sensitivity Test (Cukier et al.; Saltelli's
+    extended sampling — the method behind SALib's fast_sampler/fast)."""
+
+    def __init__(self, lo_bounds, hi_bounds, param_names, output_names, logger=None):
+        self.lb = np.asarray(lo_bounds, dtype=np.float64)
+        self.ub = np.asarray(hi_bounds, dtype=np.float64)
+        self.param_names = list(param_names)
+        self.output_names = list(output_names)
+        self.logger = logger
+        self.d = len(self.param_names)
+
+    def _frequencies(self, N: int):
+        """Per-parameter frequencies: the analyzed parameter runs at
+        omega_max; the complementary set gets low distinct frequencies."""
+        omega_max = (N - 1) // (2 * _M_HARMONICS)
+        d = self.d
+        max_compl = max(omega_max // (2 * _M_HARMONICS), 1)
+        compl = 1 + (np.arange(d - 1) % max_compl) if d > 1 else np.array([], int)
+        return omega_max, compl
+
+    def sample(self, num_samples: int = 10000) -> np.ndarray:
+        """(d * N, d) design: one block of N points per analyzed parameter."""
+        N = int(num_samples)
+        omega_max, compl = self._frequencies(N)
+        s = (2.0 * np.pi / N) * np.arange(N)
+        blocks = []
+        for i in range(self.d):
+            omega = np.empty(self.d)
+            omega[i] = omega_max
+            omega[np.arange(self.d) != i] = compl
+            x = 0.5 + (1.0 / np.pi) * np.arcsin(np.sin(omega[None, :] * s[:, None]))
+            blocks.append(x)
+        X = np.vstack(blocks)
+        return self.lb + X * (self.ub - self.lb)
+
+    def analyze(self, model, num_samples: int = 10000) -> Dict:
+        N = int(num_samples)
+        Y = np.asarray(model.evaluate(self.sample(num_samples=N)))
+        if isinstance(Y, tuple):
+            Y = Y[0]
+        if Y.ndim == 1:
+            Y = Y.reshape(-1, 1)
+        n_out = Y.shape[1]
+        omega_max, _ = self._frequencies(N)
+
+        S1s = np.zeros((self.d, n_out))
+        STs = np.zeros((self.d, n_out))
+        for i in range(self.d):
+            y = Y[i * N : (i + 1) * N, :]  # (N, n_out)
+            f = np.fft.fft(y, axis=0)
+            spectrum = (np.abs(f) ** 2) / N  # power at each integer frequency
+            half = spectrum[1 : (N + 1) // 2, :]
+            V = half.sum(axis=0)
+            # first-order: power at omega_max and its harmonics
+            idx = np.arange(1, _M_HARMONICS + 1) * omega_max - 1
+            idx = idx[idx < half.shape[0]]
+            D1 = half[idx, :].sum(axis=0)
+            # total-order: 1 - variance below omega_max/2 complement...
+            # classic estimator: power at frequencies <= omega_max/2 is
+            # "everything but parameter i"
+            cutoff = max(omega_max // 2, 1)
+            Dt = half[: cutoff - 1, :].sum(axis=0) if cutoff > 1 else 0.0
+            V = np.where(V == 0, 1.0, V)
+            S1s[i] = D1 / V
+            STs[i] = 1.0 - Dt / V
+
+        return {
+            "S1": {name: S1s[:, j] for j, name in enumerate(self.output_names)},
+            "ST": {name: STs[:, j] for j, name in enumerate(self.output_names)},
+        }
+
+
+class SA_DGSM:
+    """Derivative-based global sensitivity measures (Sobol & Kucherenko):
+    v_i = E[(df/dx_i)^2] over the box, scaled by the bound range — the
+    measure behind SALib's dgsm (reference sa.py:47-80)."""
+
+    def __init__(self, lo_bounds, hi_bounds, param_names, output_names, logger=None):
+        self.lb = np.asarray(lo_bounds, dtype=np.float64)
+        self.ub = np.asarray(hi_bounds, dtype=np.float64)
+        self.param_names = list(param_names)
+        self.output_names = list(output_names)
+        self.logger = logger
+        self.d = len(self.param_names)
+
+    def sample(self, num_samples: int = 1000, delta: float = 0.01, seed: int = 0):
+        """Base points + per-dimension forward perturbations:
+        (N * (d+1), d) design."""
+        rng = np.random.default_rng(seed)
+        N = int(num_samples)
+        span = self.ub - self.lb
+        base = self.lb + rng.uniform(size=(N, self.d)) * span * (1.0 - delta)
+        rows = [base]
+        for i in range(self.d):
+            shifted = base.copy()
+            shifted[:, i] = shifted[:, i] + delta * span[i]
+            rows.append(shifted)
+        return np.vstack(rows)
+
+    def analyze(self, model, num_samples: int = 1000, delta: float = 0.01) -> Dict:
+        N = int(num_samples)
+        X = self.sample(num_samples=N, delta=delta)
+        Y = np.asarray(model.evaluate(X))
+        if isinstance(Y, tuple):
+            Y = Y[0]
+        if Y.ndim == 1:
+            Y = Y.reshape(-1, 1)
+        n_out = Y.shape[1]
+        span = self.ub - self.lb
+
+        y0 = Y[:N]
+        var = np.var(y0, axis=0)
+        var = np.where(var == 0, 1.0, var)
+        S1s = np.zeros((self.d, n_out))
+        for i in range(self.d):
+            yi = Y[(i + 1) * N : (i + 2) * N]
+            g = (yi - y0) / (delta * span[i])
+            vi = np.mean(g * g, axis=0)
+            S1s[i] = vi * span[i] ** 2 / (np.pi**2 * var)
+
+        return {
+            "S1": {name: S1s[:, j] for j, name in enumerate(self.output_names)}
+        }

@@ -1,0 +1,86 @@
+"""Feasibility classifier and sensitivity-analysis tests
+(reference semantics: dmosopt/feasibility.py, dmosopt/sa.py)."""
+
+import numpy as np
+import pytest
+
+from dmosopt_tpu.feasibility import LogisticFeasibilityModel
+from dmosopt_tpu.sa import SA_DGSM, SA_FAST
+
+
+def test_feasibility_learns_linear_boundary():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1, 1, size=(300, 4))
+    # constraint 0: feasible iff x0 > 0; constraint 1: feasible iff x1 < 0.3
+    C = np.column_stack([X[:, 0], 0.3 - X[:, 1]])
+    m = LogisticFeasibilityModel(X, C)
+
+    x_test = np.array([[0.8, -0.5, 0.0, 0.0], [-0.8, 0.8, 0.0, 0.0]])
+    pred = m.predict(x_test)
+    assert pred.shape == (2, 2)
+    assert pred[0].tolist() == [1, 1]
+    assert pred[1].tolist() == [0, 0]
+
+    r = m.rank(x_test)
+    assert r.shape == (2,)
+    assert r[0] > 0.8 and r[1] < 0.2
+
+    proba = m.predict_proba(x_test)
+    assert proba.shape == (2, 2, 2)
+    assert np.allclose(proba.sum(axis=-1), 1.0)
+
+
+def test_feasibility_single_class_constraint():
+    rng = np.random.default_rng(1)
+    X = rng.uniform(size=(50, 3))
+    C = np.ones((50, 1))  # always feasible: no classifier trainable
+    m = LogisticFeasibilityModel(X, C)
+    assert m.weights[0] is None
+    assert np.allclose(m.rank(X[:5]), 1.0)
+
+
+class _QuadModel:
+    """y0 depends strongly on x0, weakly on x1, not at all on x2."""
+
+    def evaluate(self, X):
+        X = np.asarray(X)
+        y0 = 10.0 * X[:, 0] + 0.5 * X[:, 1]
+        y1 = 5.0 * X[:, 1] ** 2
+        return np.column_stack([y0, y1])
+
+
+@pytest.mark.parametrize("cls,kwargs", [
+    (SA_FAST, {"num_samples": 2048}),
+    (SA_DGSM, {"num_samples": 400}),
+])
+def test_sensitivity_orders_parameters(cls, kwargs):
+    sa = cls(
+        np.zeros(3), np.ones(3), ["x0", "x1", "x2"], ["f0", "f1"]
+    )
+    res = sa.analyze(_QuadModel(), **kwargs)
+    S1_f0 = res["S1"]["f0"]
+    S1_f1 = res["S1"]["f1"]
+    assert S1_f0.shape == (3,)
+    # f0 is driven by x0; x2 is irrelevant everywhere
+    assert S1_f0[0] > S1_f0[1] > S1_f0[2] - 1e-9
+    assert S1_f1[1] > S1_f1[0]
+    assert S1_f1[2] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_sa_di_mapping_in_moasmo():
+    from dmosopt_tpu.moasmo import analyze_sensitivity
+
+    di = analyze_sensitivity(
+        _QuadModel(),
+        np.zeros(3),
+        np.ones(3),
+        ["x0", "x1", "x2"],
+        ["f0", "f1"],
+        sensitivity_method_name="fast",
+        sensitivity_method_kwargs={},
+    )
+    dm = di["di_mutation"]
+    assert dm is not None and dm.shape == (3,)
+    # most sensitive parameter gets the largest di; all within [di_min, 20]
+    assert dm.max() == pytest.approx(20.0)
+    assert dm.min() >= 1.0
