@@ -1,0 +1,94 @@
+"""Benchmark-suite tests: analytic Pareto-front properties per problem
+(reference oracle style: tests/test_moo_benchmarks.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dmosopt_tpu.benchmarks.moo_benchmarks import (
+    PROBLEMS,
+    generate_problem_space,
+    get_problem,
+    get_problem_metadata,
+)
+
+
+def _optimal_x(name, n_obj, n_var):
+    """A point on the true Pareto set (distance variables at their optimum)."""
+    x = np.full(n_var, 0.3)
+    if name in ("dtlz1", "dtlz2", "dtlz3", "dtlz4", "dtlz5", "maf2", "maf4"):
+        x[n_obj - 1 :] = 0.5  # g = 0
+    elif name == "dtlz7":
+        x[n_obj - 1 :] = 0.0  # g = 1
+    return x
+
+
+def test_dtlz1_front_property():
+    # on the front: sum f_i = 0.5
+    x = _optimal_x("dtlz1", 3, 7)
+    f = np.asarray(get_problem("dtlz1", 3)(x))
+    assert f.shape == (3,)
+    assert np.sum(f) == pytest.approx(0.5, abs=1e-5)
+
+
+@pytest.mark.parametrize("name", ["dtlz2", "dtlz3", "dtlz4", "maf2"])
+def test_spherical_front_property(name):
+    n_obj = 3 if name.startswith("dtlz") else 5
+    n_var = n_obj + 9
+    x = _optimal_x(name, n_obj, n_var)
+    f = np.asarray(get_problem(name, n_obj)(x))
+    assert np.sum(f**2) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_maf4_scaling():
+    x = _optimal_x("maf4", 5, 14)
+    f = np.asarray(get_problem("maf4", 5)(x))
+    # scales 1, 100, ..., 10^8
+    assert np.sum((f / 10.0 ** (2 * np.arange(5))) ** 2) == pytest.approx(
+        1.0, abs=1e-4
+    )
+
+
+def test_dtlz7_head_objectives_pass_through():
+    x = _optimal_x("dtlz7", 3, 22)
+    f = np.asarray(get_problem("dtlz7", 3)(x))
+    assert np.allclose(f[:2], x[:2], atol=1e-6)
+
+
+@pytest.mark.parametrize("name", sorted(PROBLEMS))
+def test_batched_matches_single_and_jits(name):
+    n_obj = 5 if name.startswith("maf") else 3
+    space = generate_problem_space(name, n_obj)
+    n_var = len(space)
+    lo = np.array([v[0] for v in space.values()])
+    hi = np.array([v[1] for v in space.values()])
+    rng = np.random.default_rng(0)
+    X = (lo + rng.uniform(size=(8, n_var)) * (hi - lo)).astype(np.float32)
+    fn = get_problem(name, n_obj)
+    F_batch = np.asarray(jax.jit(fn)(jnp.asarray(X)))
+    assert F_batch.shape == (8, n_obj)
+    assert np.all(np.isfinite(F_batch))
+    for i in (0, 7):
+        f_single = np.asarray(fn(X[i]))
+        assert np.allclose(f_single, F_batch[i], rtol=1e-5, atol=1e-5), name
+
+
+def test_problem_space_and_metadata():
+    space = generate_problem_space("dtlz1", 3)
+    assert len(space) == 7
+    space = generate_problem_space("wfg1", 3)
+    assert space["x5"] == [0.0, 10.0]
+    meta = get_problem_metadata("dtlz3", 5)
+    assert meta["difficulty"] == "very_hard"
+    assert meta["n_obj_in_standard_range"]
+
+
+def test_wfg_high_objective_count_robust():
+    # the reference crashes here (empty shape-vector block); ours must not
+    fn = get_problem("wfg1", 5)
+    space = generate_problem_space("wfg1", 5)
+    n_var = len(space)
+    x = np.full((4, n_var), 0.5) * 2 * np.arange(1, n_var + 1)
+    f = np.asarray(fn(x.astype(np.float32)))
+    assert f.shape == (4, 5) and np.all(np.isfinite(f))
