@@ -1,0 +1,367 @@
+"""Sparse variational GP surrogates, TPU-native.
+
+Capability match: reference `dmosopt/model.py:98-1048` GPflow family —
+`VGP_Matern` (:991, full variational GP), `SVGP_Matern` (:769, sparse
+with shared kernel/inducing structure), `SPV_Matern` (:547, separate
+independent kernels + inducing points per output), `SIV_Matern` (:328,
+shared inducing variables + shared kernel), `CRV_Matern` (:98, linear
+coregionalization mixing latent GPs across objectives).
+
+TPU redesign: one core trainer (`fit_svgp`) implements the uncollapsed
+Hensman-style SVGP bound with a Gaussian likelihood; all per-objective
+(or per-latent) computations are `vmap`ed so every variant is a
+configuration — shared vs separate kernels/inducing points, and an
+optional coregionalization mixing matrix W — rather than a separate
+class hierarchy. Training is Adam under `lax.scan` with minibatching by
+index shuffling (replacing GPflow's TF session loops); whitened
+variational parameterization (q over v with u = L_uu v) keeps the KL
+well-conditioned in f32.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from dmosopt_tpu.models.gp import (
+    _KERNELS,
+    _Bounds,
+    _prepare_training_data,
+)
+from dmosopt_tpu.utils.prng import as_key
+
+_JITTER = 1e-5
+_LOG2PI = math.log(2.0 * math.pi)
+
+
+class SVGPParams(NamedTuple):
+    """Trainable state. Leading axis Q = number of independent GPs
+    (objectives, or latent processes for coregionalization); axes may be
+    broadcast when kernels/inducing points are shared."""
+
+    u_amp: jax.Array  # (Qk,)
+    u_ls: jax.Array  # (Qk, L)
+    u_noise: jax.Array  # (d,) one observation noise per output
+    Z: jax.Array  # (Qz, M, n) inducing locations
+    vm: jax.Array  # (Q, M) whitened variational mean
+    vL: jax.Array  # (Q, M, M) whitened variational scale (lower)
+    W: Optional[jax.Array]  # (d, Q) mixing matrix or None
+
+
+class SVGPFit(NamedTuple):
+    params: SVGPParams
+    bounds_amp: _Bounds
+    bounds_ls: _Bounds
+    bounds_noise: _Bounds
+    elbo: jax.Array
+
+
+def _tril(M_):
+    return jnp.tril(M_)
+
+
+def _latent_moments(amp, ls, Z, vm, vL, Xq, kernel_fn):
+    """q(f) moments for ONE latent GP at query points Xq.
+    Whitened: u = L_uu v, q(v) = N(vm, vL vL^T).
+    mean = Ksu Kuu^-1 L_uu vm = Ksu L_uu^-T vm
+    var  = k_ss - ||a||^2 + ||vL^T a||^2, a = L_uu^-1 Kus."""
+    M = Z.shape[0]
+    Kuu = kernel_fn(Z, Z, ls, amp) + _JITTER * amp * jnp.eye(M)
+    Luu = jnp.linalg.cholesky(Kuu)
+    Kus = kernel_fn(Z, Xq, ls, amp)  # (M, B)
+    a = jax.scipy.linalg.solve_triangular(Luu, Kus, lower=True)  # (M, B)
+    mean = a.T @ vm
+    kss = amp * jnp.ones(Xq.shape[0])  # stationary kernels: k(x,x) = amp
+    var = kss - jnp.sum(a * a, axis=0) + jnp.sum((_tril(vL).T @ a) ** 2, axis=0)
+    return mean, jnp.maximum(var, 1e-10)
+
+
+def _kl_whitened(vm, vL):
+    """KL(q(v) || N(0, I)) for whitened variational parameters."""
+    L = _tril(vL)
+    logdet = jnp.sum(jnp.log(jnp.maximum(jnp.diag(L) ** 2, 1e-20)))
+    trace = jnp.sum(L * L)
+    return 0.5 * (trace + jnp.sum(vm * vm) - vm.shape[0] - logdet)
+
+
+def _unpack(params: SVGPParams, b_amp, b_ls, b_noise):
+    amp = b_amp.forward(params.u_amp)
+    ls = b_ls.forward(params.u_ls)
+    noise = b_noise.forward(params.u_noise)
+    return amp, ls, noise
+
+
+def _elbo(params: SVGPParams, b_amp, b_ls, b_noise, Xb, Yb, N, kernel_fn):
+    """Minibatch evidence lower bound. Xb (B, n); Yb (B, d)."""
+    amp, ls, noise = _unpack(params, b_amp, b_ls, b_noise)
+    Q = params.vm.shape[0]
+    Qk = params.u_amp.shape[0]
+    Qz = params.Z.shape[0]
+    B, d = Yb.shape
+
+    def one(q):
+        kq = jnp.minimum(q, Qk - 1)
+        zq = jnp.minimum(q, Qz - 1)
+        return _latent_moments(
+            amp[kq], ls[kq], params.Z[zq], params.vm[q], params.vL[q], Xb, kernel_fn
+        )
+
+    means, variances = jax.vmap(one)(jnp.arange(Q))  # (Q, B)
+
+    if params.W is not None:
+        f_mean = params.W @ means  # (d, B)
+        f_var = (params.W**2) @ variances
+    else:
+        f_mean, f_var = means, variances  # Q == d
+
+    err = Yb.T - f_mean  # (d, B)
+    lik = -0.5 * (
+        _LOG2PI
+        + jnp.log(noise)[:, None]
+        + (err**2 + f_var) / noise[:, None]
+    )
+    kl = jax.vmap(_kl_whitened)(params.vm, params.vL).sum()
+    return (N / B) * jnp.sum(lik) - kl
+
+
+def fit_svgp(
+    key,
+    X,
+    Y,
+    n_inducing: int,
+    n_latent: Optional[int] = None,
+    share_kernel: bool = False,
+    share_inducing: bool = True,
+    kernel: str = "matern52",
+    lengthscale_bounds=(1e-3, 100.0),
+    amplitude_bounds=(1e-4, 1e3),
+    noise_bounds=(1e-6, 1.0),
+    ard: bool = False,
+    batch_size: int = 256,
+    n_iter: int = 400,
+    learning_rate: float = 0.05,
+) -> SVGPFit:
+    """Fit the SVGP family. Q latent GPs (= n_outputs unless `n_latent`
+    sets a coregionalization); kernels/inducing points shared or separate
+    per latent."""
+    N, n = X.shape
+    d = Y.shape[1]
+    Q = n_latent if n_latent is not None else d
+    coreg = n_latent is not None
+    M = min(n_inducing, N)
+    L = n if ard else 1
+
+    b_amp = _Bounds(jnp.asarray(amplitude_bounds[0]), jnp.asarray(amplitude_bounds[1]))
+    b_ls = _Bounds(
+        jnp.asarray(lengthscale_bounds[0]), jnp.asarray(lengthscale_bounds[1])
+    )
+    b_noise = _Bounds(jnp.asarray(noise_bounds[0]), jnp.asarray(noise_bounds[1]))
+    kernel_fn = _KERNELS[kernel]
+
+    Qk = 1 if share_kernel else Q
+    Qz = 1 if share_inducing else Q
+
+    k_z, k_p, k_b = jax.random.split(as_key(key), 3)
+    # inducing points: random training subset
+    idx = jax.random.choice(k_z, N, (Qz, M), replace=True)
+    Z0 = X[idx]  # (Qz, M, n)
+
+    params = SVGPParams(
+        u_amp=jnp.broadcast_to(b_amp.inverse(jnp.asarray(1.0)), (Qk,)),
+        u_ls=jnp.broadcast_to(b_ls.inverse(jnp.asarray(0.5)), (Qk, L)),
+        u_noise=jnp.broadcast_to(b_noise.inverse(jnp.asarray(0.05)), (d,)),
+        Z=Z0,
+        vm=jnp.zeros((Q, M)),
+        vL=jnp.broadcast_to(jnp.eye(M), (Q, M, M)),
+        W=(
+            0.1 * jax.random.normal(k_p, (d, Q)) + jnp.eye(d, Q)
+            if coreg
+            else None
+        ),
+    )
+
+    B = min(batch_size, N)
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(params)
+
+    loss_fn = lambda p, Xb, Yb: -_elbo(p, b_amp, b_ls, b_noise, Xb, Yb, N, kernel_fn)
+
+    @jax.jit
+    def train(params, opt_state, key):
+        def step(carry, k):
+            params, opt_state = carry
+            sel = jax.random.choice(k, N, (B,), replace=False)
+            g = jax.grad(loss_fn)(params, X[sel], Y[sel])
+            updates, opt_state = opt.update(g, opt_state)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), None
+
+        keys = jax.random.split(key, n_iter)
+        (params, opt_state), _ = jax.lax.scan(step, (params, opt_state), keys)
+        final = -loss_fn(params, X[: min(N, 1024)], Y[: min(N, 1024)])
+        return params, final
+
+    params, elbo = train(params, opt_state, k_b)
+    return SVGPFit(params, b_amp, b_ls, b_noise, elbo)
+
+
+def svgp_predict(fit: SVGPFit, Xq, kernel: str = "matern52"):
+    """Posterior mean/variance per output at Xq. Returns ((B, d), (B, d));
+    variance includes the observation noise (consistent with GPR)."""
+    params = fit.params
+    amp, ls, noise = _unpack(params, fit.bounds_amp, fit.bounds_ls, fit.bounds_noise)
+    kernel_fn = _KERNELS[kernel]
+    Q = params.vm.shape[0]
+    Qk = params.u_amp.shape[0]
+    Qz = params.Z.shape[0]
+
+    def one(q):
+        kq = jnp.minimum(q, Qk - 1)
+        zq = jnp.minimum(q, Qz - 1)
+        return _latent_moments(
+            amp[kq], ls[kq], params.Z[zq], params.vm[q], params.vL[q], Xq, kernel_fn
+        )
+
+    means, variances = jax.vmap(one)(jnp.arange(Q))  # (Q, B)
+    if params.W is not None:
+        f_mean = params.W @ means
+        f_var = (params.W**2) @ variances
+    else:
+        f_mean, f_var = means, variances
+    return (f_mean + 0.0).T, (f_var + noise[:, None]).T
+
+
+# ---------------------------------------------------------------- wrappers
+
+
+class _SVGPBase:
+    """Shared wrapper: reference surrogate interface
+    (`predict` -> (mean, var), `evaluate`), unit-box x normalization and
+    per-objective y standardization like model.py:1216-1229."""
+
+    kernel = "matern52"
+    share_kernel = False
+    share_inducing = True
+    n_latent_factor: Optional[float] = None  # CRV: latents = ceil(d/1)...
+    full_inducing = False  # VGP: inducing = all training points
+
+    def __init__(
+        self,
+        xin,
+        yin,
+        nInput,
+        nOutput,
+        xlb,
+        xub,
+        seed=None,
+        inducing_fraction: float = 0.25,
+        min_inducing: int = 100,
+        batch_size: int = 256,
+        n_iter: int = 400,
+        learning_rate: float = 0.05,
+        anisotropic: bool = False,
+        num_latent_gps: Optional[int] = None,
+        return_mean_variance: bool = False,
+        nan: Optional[str] = "remove",
+        top_k: Optional[int] = None,
+        logger=None,
+        **kwargs,
+    ):
+        self.return_mean_variance = return_mean_variance
+        self.logger = logger
+        X, Yn, y_mean, y_std = _prepare_training_data(
+            self, xin, yin, nInput, nOutput, xlb, xub, nan, top_k
+        )
+        N = X.shape[0]
+        if self.full_inducing:
+            n_inducing = N
+        else:
+            # reference sizing: inducing_fraction * N, at least min_inducing
+            # (model.py:813-818)
+            n_inducing = min(max(int(inducing_fraction * N), min_inducing), N)
+        n_latent = None
+        if self.n_latent_factor is not None:
+            n_latent = num_latent_gps or max(
+                1, int(np.ceil(nOutput * self.n_latent_factor))
+            )
+        fit = fit_svgp(
+            as_key(seed),
+            jnp.asarray(X, jnp.float32),
+            jnp.asarray(Yn, jnp.float32),
+            n_inducing=n_inducing,
+            n_latent=n_latent,
+            share_kernel=self.share_kernel,
+            share_inducing=self.share_inducing,
+            kernel=self.kernel,
+            ard=bool(anisotropic),
+            batch_size=batch_size,
+            n_iter=n_iter,
+            learning_rate=learning_rate,
+        )
+        self.fit = fit
+        self.y_mean = jnp.asarray(y_mean, jnp.float32)
+        self.y_std = jnp.asarray(y_std, jnp.float32)
+
+    def predict_normalized(self, Xq):
+        mean, var = svgp_predict(self.fit, Xq, kernel=self.kernel)
+        return self.y_mean + self.y_std * mean, (self.y_std**2) * var
+
+    def normalize_x(self, xin):
+        return (jnp.asarray(xin, jnp.float32) - self.xlb.astype(np.float32)) / (
+            self.xrg.astype(np.float32)
+        )
+
+    def predict(self, xin):
+        x = jnp.atleast_2d(jnp.asarray(xin, jnp.float32))
+        return self.predict_normalized(self.normalize_x(x))
+
+    def evaluate(self, x):
+        mean, var = self.predict(x)
+        if self.return_mean_variance:
+            return mean, var
+        return mean
+
+
+class VGP_Matern(_SVGPBase):
+    """Full variational GP: inducing points = training points
+    (reference model.py:991-1180)."""
+
+    full_inducing = True
+
+
+class SVGP_Matern(_SVGPBase):
+    """Sparse variational GP, shared kernel + shared inducing locations,
+    independent variational posteriors (reference model.py:769-988)."""
+
+    share_kernel = True
+    share_inducing = True
+
+
+class SPV_Matern(_SVGPBase):
+    """Separate independent kernels and inducing points per output
+    (reference model.py:547-766)."""
+
+    share_kernel = False
+    share_inducing = False
+
+
+class SIV_Matern(_SVGPBase):
+    """Shared inducing variables + shared kernel (reference model.py:328-544)."""
+
+    share_kernel = True
+    share_inducing = True
+
+
+class CRV_Matern(_SVGPBase):
+    """Linear coregionalization: outputs mix `num_latent_gps` latent GPs
+    through a learned W (reference model.py:98-325)."""
+
+    share_kernel = False
+    share_inducing = True
+    n_latent_factor = 1.0

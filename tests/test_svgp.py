@@ -1,0 +1,103 @@
+"""Sparse variational GP surrogate tests
+(reference semantics: dmosopt/model.py GPflow family)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from dmosopt_tpu.models.svgp import (
+    CRV_Matern,
+    SIV_Matern,
+    SPV_Matern,
+    SVGP_Matern,
+    VGP_Matern,
+)
+
+
+def _data(n=200, d_in=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(size=(n, d_in))
+    Y = np.column_stack(
+        [
+            np.sin(3 * X[:, 0]) + 0.5 * X[:, 1],
+            np.cos(2 * X[:, 1]) * X[:, 2],
+        ]
+    )
+    Y += 0.01 * rng.normal(size=Y.shape)
+    return X, Y
+
+
+FIT_KW = dict(n_iter=200, batch_size=128, seed=0)
+
+
+@pytest.mark.parametrize(
+    "cls", [SVGP_Matern, SPV_Matern, SIV_Matern, CRV_Matern, VGP_Matern]
+)
+def test_svgp_variants_fit_and_predict(cls):
+    X, Y = _data()
+    m = cls(X, Y, 4, 2, np.zeros(4), np.ones(4), **FIT_KW)
+    mean, var = m.predict(X[:50])
+    mean, var = np.asarray(mean), np.asarray(var)
+    assert mean.shape == (50, 2) and var.shape == (50, 2)
+    assert np.all(var > 0)
+    # in-sample fit should beat predicting the mean
+    resid = np.mean((mean - Y[:50]) ** 2, axis=0)
+    base = np.var(Y, axis=0)
+    assert np.all(resid < 0.5 * base), (cls.__name__, resid, base)
+
+
+def test_svgp_uses_fewer_inducing_points():
+    X, Y = _data(n=300)
+    m = SVGP_Matern(
+        X, Y, 4, 2, np.zeros(4), np.ones(4),
+        inducing_fraction=0.2, min_inducing=30, **FIT_KW,
+    )
+    assert m.fit.params.Z.shape[1] == 60  # 0.2 * 300
+    v = VGP_Matern(X, Y, 4, 2, np.zeros(4), np.ones(4), **FIT_KW)
+    assert v.fit.params.Z.shape[1] == 300
+
+
+def test_crv_has_mixing_matrix():
+    X, Y = _data()
+    m = CRV_Matern(X, Y, 4, 2, np.zeros(4), np.ones(4), **FIT_KW)
+    assert m.fit.params.W is not None
+    assert m.fit.params.W.shape == (2, 2)
+
+
+def test_svgp_mean_variance_interface():
+    X, Y = _data(n=120)
+    m = SVGP_Matern(
+        X, Y, 4, 2, np.zeros(4), np.ones(4),
+        return_mean_variance=True, **FIT_KW,
+    )
+    out = m.evaluate(X[:10])
+    assert isinstance(out, tuple) and len(out) == 2
+
+
+def test_svgp_in_moasmo_epoch():
+    from dmosopt_tpu import moasmo
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+
+    rng = np.random.default_rng(1)
+    X = rng.uniform(size=(120, 6)).astype(np.float32)
+    Y = np.asarray(zdt1(jnp.asarray(X)))
+    gen = moasmo.epoch(
+        num_generations=5,
+        param_names=[f"x{i}" for i in range(6)],
+        objective_names=["f1", "f2"],
+        xlb=np.zeros(6),
+        xub=np.ones(6),
+        pct=0.5,
+        Xinit=X,
+        Yinit=Y,
+        C=None,
+        pop=16,
+        optimizer_name="nsga2",
+        surrogate_method_name="svgp",
+        surrogate_method_kwargs={"n_iter": 100, "min_inducing": 40, "seed": 0},
+        local_random=2,
+    )
+    with pytest.raises(StopIteration) as ex:
+        next(gen)
+    res = ex.value.value
+    assert res["x_resample"].shape == (8, 6)
