@@ -1,0 +1,231 @@
+"""Command-line tools: analyze / train / onestep.
+
+Capability match: the reference ships three click commands —
+`dmosopt-analyze` (Pareto extraction + kNN-to-origin ranking,
+dmosopt_analyze.py:39-160), `dmosopt-train` (offline surrogate fitting
+from stored evals, dmosopt_train.py), and `dmosopt-onestep` (one
+resample step from a store, dmosopt_onestep.py). The reference CLIs are
+stale against their own store API (SURVEY §3.5); these implement the
+same intent against the dmosopt_tpu HDF5 schema.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+
+import click
+import numpy as np
+
+from dmosopt_tpu import moasmo
+from dmosopt_tpu.storage import h5_load_raw
+
+
+def _load(file_path, opt_id):
+    raw = h5_load_raw(file_path, opt_id)
+    problem_ids = sorted(raw["problem_ids"]) if raw["problem_ids"] else [0]
+    return raw, problem_ids
+
+
+def _stack_evals(entries):
+    x = np.vstack([e.parameters for e in entries])
+    y = np.vstack([e.objectives for e in entries])
+    c = (
+        np.vstack([e.constraints for e in entries])
+        if entries[0].constraints is not None
+        else None
+    )
+    f = (
+        np.vstack([np.atleast_1d(e.features) for e in entries])
+        if entries[0].features is not None
+        else None
+    )
+    epochs = np.concatenate([np.atleast_1d(e.epoch) for e in entries])
+    return x, y, f, c, epochs
+
+
+@click.command("analyze")
+@click.option("--file-path", "-p", required=True, type=click.Path(exists=True))
+@click.option("--opt-id", required=True, type=str)
+@click.option("--constraints/--no-constraints", default=True)
+@click.option("--knn", default=0, type=int,
+              help="rank the k best points nearest the normalized origin")
+@click.option("--filter-objectives", type=str, default=None,
+              help="comma-separated subset of objectives")
+@click.option("--output-file", type=click.Path(), default=None)
+@click.option("--verbose", "-v", is_flag=True)
+def analyze(file_path, opt_id, constraints, knn, filter_objectives,
+            output_file, verbose):
+    """Extract and rank the non-dominated set from a results store
+    (intent of reference dmosopt_analyze.py)."""
+    raw, problem_ids = _load(file_path, opt_id)
+    objective_names = raw["objective_names"]
+    param_names = raw["parameter_names"]
+
+    out = {}
+    for problem_id in problem_ids:
+        entries = raw["evals"].get(problem_id, [])
+        if not entries:
+            click.echo(f"No results for id {problem_id}")
+            continue
+        x, y, f, c, epochs = _stack_evals(entries)
+
+        names = list(objective_names)
+        if filter_objectives is not None:
+            keep = [i for i, n in enumerate(names)
+                    if n in set(filter_objectives.split(","))]
+            y = y[:, keep]
+            names = [names[i] for i in keep]
+
+        click.echo(f"Found {x.shape[0]} results for id {problem_id}")
+        best_x, best_y, best_f, best_c, best_epoch, _ = moasmo.get_best(
+            x, y, f, c, x.shape[1], y.shape[1], epochs=epochs,
+            feasible=constraints,
+        )
+        click.echo(f"Found {best_x.shape[0]} best results for id {problem_id}")
+
+        order = np.arange(best_y.shape[0])
+        if knn > 0 and best_y.shape[0] > 0:
+            # kNN-to-origin ranking on max-normalized objectives
+            # (reference dmosopt_analyze.py:130-150)
+            pts = best_y.copy()
+            for j in range(pts.shape[1]):
+                mx = np.max(pts[:, j])
+                if mx > 0:
+                    pts[:, j] = pts[:, j] / mx
+            d = np.linalg.norm(pts, axis=1)
+            order = np.argsort(d)[: min(knn, len(d))]
+
+        rows = OrderedDict()
+        for i in order:
+            row = {
+                "objectives": {n: float(best_y[i, j]) for j, n in enumerate(names)},
+                "parameters": {n: float(best_x[i, j])
+                               for j, n in enumerate(param_names)},
+            }
+            if best_epoch is not None:
+                row["epoch"] = int(best_epoch[i])
+            if best_c is not None:
+                row["constraints"] = [float(v) for v in best_c[i]]
+            rows[int(i)] = row
+            if verbose or output_file is None:
+                click.echo(f"{i}: {row['objectives']} @ {row['parameters']}")
+        out[str(problem_id)] = rows
+
+    if output_file is not None:
+        with open(output_file, "w") as fh:
+            json.dump(out, fh, indent=2)
+        click.echo(f"wrote {output_file}")
+
+
+@click.command("train")
+@click.option("--file-path", "-p", required=True, type=click.Path(exists=True))
+@click.option("--opt-id", required=True, type=str)
+@click.option("--problem-id", default=0, type=int)
+@click.option("--surrogate-method", default="gpr", type=str)
+@click.option("--surrogate-kwargs", default="{}", type=str,
+              help="JSON dict of surrogate options")
+@click.option("--output-file", "-o", required=True, type=click.Path())
+def train(file_path, opt_id, problem_id, surrogate_method, surrogate_kwargs,
+          output_file):
+    """Fit a surrogate offline from stored evaluations and persist it
+    (intent of reference dmosopt_train.py; joblib dump :97)."""
+    raw, _ = _load(file_path, opt_id)
+    entries = raw["evals"].get(problem_id, [])
+    if not entries:
+        raise click.ClickException(f"no evaluations for problem {problem_id}")
+    x, y, f, c, _ = _stack_evals(entries)
+    space = raw["parameter_space"]
+
+    sm = moasmo.train(
+        x.shape[1], y.shape[1], space.bound1, space.bound2, x, y, c,
+        surrogate_method_name=surrogate_method,
+        surrogate_method_kwargs=json.loads(surrogate_kwargs),
+    )
+    import joblib
+
+    joblib.dump(sm, output_file)
+    click.echo(f"trained {surrogate_method} surrogate on {x.shape[0]} evals "
+               f"-> {output_file}")
+
+
+@click.command("onestep")
+@click.option("--file-path", "-p", required=True, type=click.Path(exists=True))
+@click.option("--opt-id", required=True, type=str)
+@click.option("--problem-id", default=0, type=int)
+@click.option("--population-size", default=100, type=int)
+@click.option("--num-generations", default=100, type=int)
+@click.option("--resample-fraction", default=0.25, type=float)
+@click.option("--optimizer", default="nsga2", type=str)
+@click.option("--surrogate-method", default="gpr", type=str)
+@click.option("--surrogate-kwargs", default="{}", type=str)
+@click.option("--output-file", "-o", type=click.Path(), default=None)
+@click.option("--seed", default=0, type=int)
+def onestep(file_path, opt_id, problem_id, population_size, num_generations,
+            resample_fraction, optimizer, surrogate_method, surrogate_kwargs,
+            output_file, seed):
+    """Run one surrogate epoch from stored evals and emit the resample
+    candidates (intent of reference dmosopt_onestep.py)."""
+    raw, _ = _load(file_path, opt_id)
+    entries = raw["evals"].get(problem_id, [])
+    if not entries:
+        raise click.ClickException(f"no evaluations for problem {problem_id}")
+    x, y, f, c, _ = _stack_evals(entries)
+    space = raw["parameter_space"]
+    param_names = raw["parameter_names"]
+    objective_names = raw["objective_names"]
+
+    gen = moasmo.epoch(
+        num_generations,
+        param_names,
+        objective_names,
+        space.bound1,
+        space.bound2,
+        resample_fraction,
+        x,
+        y,
+        c,
+        pop=population_size,
+        optimizer_name=optimizer,
+        surrogate_method_name=surrogate_method,
+        surrogate_method_kwargs=json.loads(surrogate_kwargs),
+        local_random=seed,
+    )
+    try:
+        next(gen)
+        raise click.ClickException(
+            "onestep requires a surrogate-mode epoch (it must not request "
+            "real evaluations)"
+        )
+    except StopIteration as ex:
+        res = ex.value
+    x_resample = res["x_resample"]
+    y_pred = res["y_pred"]
+    click.echo(f"proposed {x_resample.shape[0]} resample candidates")
+    if output_file is not None:
+        np.savez(output_file, x_resample=x_resample, y_pred=y_pred)
+        click.echo(f"wrote {output_file}")
+    else:
+        for i in range(x_resample.shape[0]):
+            click.echo(
+                f"{i}: x={np.array2string(x_resample[i], precision=4)} "
+                f"pred={np.array2string(y_pred[i], precision=4)}"
+            )
+
+
+@click.group()
+def cli():
+    """dmosopt-tpu command-line tools."""
+
+
+cli.add_command(analyze)
+cli.add_command(train)
+cli.add_command(onestep)
+
+
+def main():  # console entry point
+    cli(prog_name="dmosopt-tpu")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from dmosopt_tpu.cli import main
+
+main()

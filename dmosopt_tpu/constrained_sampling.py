@@ -1,0 +1,413 @@
+"""Constrained parameter-space sampling with inter-parameter bound
+expressions.
+
+Capability match: reference `dmosopt/constrained_sampling.py` —
+`ParamSpacePoints` (:12): a space mixing unconstrained parameters
+(``[lo, hi]`` lists) and constrained parameters (dicts with absolute
+bounds, lower/upper bound *expressions* in terms of other parameters,
+and a per-parameter sampling method uniform/normal/percentile), plus
+evolutionary child generation from parent populations (`get_children`
+:117). The reference parses bound expressions with a sly LALR parser
+(:465-572); here a small self-contained tokenizer + recursive-descent
+parser evaluates expressions directly on NumPy arrays, so each
+constraint's bound is computed for ALL samples at once instead of one
+parse per sample per dependency.
+
+Redesign notes:
+- expressions may reference other parameters by name (the reference
+  only splices the dependency's value textually in front of the
+  expression; both forms work here),
+- dependency resolution iterates to a fixed point and reports circular
+  dependencies (the reference handles one level only,
+  constrained_sampling.py:310-312).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu import sampling as sampling_mod
+from dmosopt_tpu.ops import polynomial_mutation, sbx_crossover
+from dmosopt_tpu.utils.prng import as_generator, as_key
+
+
+# ------------------------------------------------------- expression parser
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<num>[0-9]*\.?[0-9]+(?:[eE][-+]?\d+)?)"
+    r"|(?P<id>[a-zA-Z_][a-zA-Z0-9_]*)"
+    r"|(?P<op>\*\*|[-+*/()]))"
+)
+
+
+def tokenize(text: str) -> List[Tuple[str, str]]:
+    tokens = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise ValueError(f"cannot tokenize {text[pos:]!r}")
+        if m.group("num") is not None:
+            tokens.append(("num", m.group("num")))
+        elif m.group("id") is not None:
+            name = m.group("id")
+            if name.lower() in ("min", "max"):
+                tokens.append(("minmax", name.lower()))
+            else:
+                tokens.append(("id", name))
+        else:
+            tokens.append(("op", m.group("op")))
+        pos = m.end()
+    return tokens
+
+
+class BoundExpression:
+    """Arithmetic over numbers and parameter names with ``+ - * / **``,
+    parentheses, and infix ``min``/``max`` (the reference grammar,
+    constrained_sampling.py:529-572). Evaluate with an environment of
+    per-sample arrays."""
+
+    def __init__(self, text: str):
+        self.text = text
+        self._tokens = tokenize(text)
+
+    def variables(self) -> List[str]:
+        return [v for t, v in self._tokens if t == "id"]
+
+    def evaluate(self, env: Dict[str, np.ndarray]):
+        tokens = list(self._tokens)
+        pos = [0]
+
+        def peek():
+            return tokens[pos[0]] if pos[0] < len(tokens) else (None, None)
+
+        def take():
+            tok = tokens[pos[0]]
+            pos[0] += 1
+            return tok
+
+        def atom():
+            kind, val = peek()
+            if kind == "op" and val == "(":
+                take()
+                out = expr()
+                k, v = take()
+                if v != ")":
+                    raise ValueError(f"expected ')' in {self.text!r}")
+                return out
+            if kind == "op" and val in ("+", "-"):
+                take()
+                sub = atom()
+                return sub if val == "+" else -sub
+            if kind == "num":
+                take()
+                return float(val)
+            if kind == "id":
+                take()
+                if val not in env:
+                    raise KeyError(
+                        f"unknown parameter {val!r} in expression {self.text!r}"
+                    )
+                return np.asarray(env[val])
+            raise ValueError(f"unexpected token {val!r} in {self.text!r}")
+
+        def power():
+            base = atom()
+            kind, val = peek()
+            if kind == "op" and val == "**":
+                take()
+                return base ** power()
+            return base
+
+        def term():
+            out = power()
+            while True:
+                kind, val = peek()
+                if kind == "op" and val in ("*", "/"):
+                    take()
+                    rhs = power()
+                    out = out * rhs if val == "*" else out / rhs
+                elif kind == "minmax":
+                    take()
+                    rhs = power()
+                    out = np.minimum(out, rhs) if val == "min" else np.maximum(out, rhs)
+                else:
+                    return out
+
+        def expr():
+            out = term()
+            while True:
+                kind, val = peek()
+                if kind == "op" and val in ("+", "-"):
+                    take()
+                    rhs = term()
+                    out = out + rhs if val == "+" else out - rhs
+                else:
+                    return out
+
+        result = expr()
+        if pos[0] != len(tokens):
+            raise ValueError(f"trailing tokens in expression {self.text!r}")
+        return result
+
+
+# ------------------------------------------------------------- the sampler
+
+
+class ParamSpacePoints:
+    """Sample a parameter space with expression-constrained bounds
+    (reference: dmosopt/constrained_sampling.py:12-463).
+
+    Space entries: ``name: [lo, hi]`` (unconstrained) or
+    ``name: {"abs": [lo, hi], "lb": [(param, "expr"), ...],
+    "ub": [...], "method": ("uniform"|"normal"|"percentile", ...)}``.
+    A dependency ``(param, "+ 5")`` bounds this parameter by
+    ``param + 5`` (the expression is applied to the named parameter's
+    sampled value); expressions may also reference parameters by name.
+    """
+
+    def __init__(self, N, Space, Method=None, seed=None, parents=None):
+        self.seed = seed
+        self.rng = as_generator(seed)
+        self.N_params = int(N)
+        self.Space = Space
+        self.parents_dict = parents
+        self._analyze()
+        self.MethodUnc = Method
+        self.SpaceUncMethod = Method or ("Evo" if parents is not None else "slh")
+        self._generate()
+
+    # -------------------------------------------------------------- setup
+
+    def _analyze(self):
+        self.param_keys = np.sort(list(self.Space.keys()))
+        self.prm_idx_unc = np.array(
+            [i for i, k in enumerate(self.param_keys) if isinstance(self.Space[k], list)],
+            dtype=int,
+        )
+        self.prm_idx_con = np.array(
+            [i for i, k in enumerate(self.param_keys) if isinstance(self.Space[k], dict)],
+            dtype=int,
+        )
+        self.prm_unc_dim = len(self.prm_idx_unc)
+        self.prm_con_dim = len(self.prm_idx_con)
+        self.param_dim = self.prm_unc_dim + self.prm_con_dim
+        self.unc_intervals = np.asarray(
+            [self.Space[self.param_keys[i]] for i in self.prm_idx_unc], dtype=float
+        ).reshape(self.prm_unc_dim, 2)
+
+    # ----------------------------------------------------------- pipeline
+
+    def _generate(self):
+        self._generate_unconstrained()
+        if self.prm_con_dim:
+            self._generate_constrained()
+
+    def _generate_unconstrained(self):
+        self.param_arr = np.full((self.N_params, self.param_dim), np.nan)
+        if self.prm_unc_dim == 0:
+            return
+        method = self.SpaceUncMethod
+        if method == "Evo":
+            X = self._get_children()
+            self.N_params = X.shape[0]
+            self.param_arr = np.full((self.N_params, self.param_dim), np.nan)
+        elif callable(method):
+            X = method(self.N_params, self.prm_unc_dim, self.rng)
+            xlb, xub = self.unc_intervals[:, 0], self.unc_intervals[:, 1]
+            X = X * (xub - xlb) + xlb
+        else:
+            fn = getattr(sampling_mod, method, None)
+            if fn is None:
+                raise RuntimeError(f"Unknown method {method}")
+            X = np.asarray(fn(self.N_params, self.prm_unc_dim, self.rng))
+            xlb, xub = self.unc_intervals[:, 0], self.unc_intervals[:, 1]
+            X = X * (xub - xlb) + xlb
+        self.param_arr[:, self.prm_idx_unc] = X
+
+    # ---------------------------------------------- dependency resolution
+
+    def _dependencies(self, key) -> List[str]:
+        spec = self.Space[key]
+        deps = []
+        for side in ("lb", "ub"):
+            for dep_param, expr in spec.get(side, []):
+                deps.append(dep_param)
+                deps.extend(BoundExpression(expr).variables())
+        return deps
+
+    def _resolution_order(self) -> List[str]:
+        """Topological order of constrained parameters; iterates to a fixed
+        point and raises on circular dependencies."""
+        unc = set(self.param_keys[self.prm_idx_unc])
+        remaining = {self.param_keys[i] for i in self.prm_idx_con}
+        resolved = set(unc)
+        order = []
+        while remaining:
+            progress = [
+                k for k in sorted(remaining)
+                if set(self._dependencies(k)) <= resolved
+            ]
+            if not progress:
+                raise ValueError(
+                    f"circular or unsatisfiable constraint dependencies "
+                    f"among {sorted(remaining)}"
+                )
+            for k in progress:
+                order.append(k)
+                resolved.add(k)
+                remaining.discard(k)
+        return order
+
+    # --------------------------------------------------------- constrained
+
+    def _env(self) -> Dict[str, np.ndarray]:
+        return {
+            self.param_keys[i]: self.param_arr[:, i]
+            for i in range(self.param_dim)
+            if not np.all(np.isnan(self.param_arr[:, i]))
+        }
+
+    def _bounds_from_relations(self, relations, lower: bool):
+        """Per-sample bound from dependency relations: the max of lower
+        candidates / min of upper candidates (reference :357-365)."""
+        env = self._env()
+        cands = []
+        for dep_param, expr in relations:
+            if dep_param not in env:
+                raise KeyError(f"dependency {dep_param!r} not yet sampled")
+            base = env[dep_param]
+            # the reference splices the value in front of the expression;
+            # an expression starting with an operator continues from `base`
+            text = expr.strip()
+            if text and text[0] in "+-*/" or text[:2] == "**":
+                vals = BoundExpression(f"__base__ {text}").evaluate(
+                    {**env, "__base__": base}
+                )
+            else:
+                vals = BoundExpression(text).evaluate(env)
+            cands.append(np.broadcast_to(np.asarray(vals, float), (self.N_params,)))
+        stacked = np.stack(cands, axis=1)
+        return stacked.max(axis=1) if lower else stacked.min(axis=1)
+
+    def _solve_bounds(self, spec) -> Tuple[np.ndarray, np.ndarray]:
+        absbnds = spec.get("abs")
+        lb = ub = None
+        if spec.get("lb"):
+            lb = self._bounds_from_relations(spec["lb"], lower=True)
+        if spec.get("ub"):
+            ub = self._bounds_from_relations(spec["ub"], lower=False)
+
+        if absbnds is None:
+            if lb is None or ub is None:
+                raise KeyError(
+                    "Constrained parameter requires both lower and upper "
+                    "bounds when absolute bounds are not specified."
+                )
+        else:
+            if lb is None:
+                lb = np.full(self.N_params, float(absbnds[0]))
+            if ub is None:
+                ub = np.full(self.N_params, float(absbnds[1]))
+            # overconstrained samples fall back to the absolute range
+            # (reference :409-425)
+            invalid = lb >= ub
+            if invalid.any():
+                lb = np.where(invalid, float(absbnds[0]), lb)
+                ub = np.where(invalid, float(absbnds[1]), ub)
+            if spec.get("clip_abs", True):
+                lb = np.clip(lb, float(absbnds[0]), float(absbnds[1]))
+                ub = np.clip(ub, float(absbnds[0]), float(absbnds[1]))
+        return lb, ub
+
+    def _sample_values(self, lb, ub, method) -> np.ndarray:
+        """Per-sample draw within [lb, ub] (reference :449-463)."""
+        if isinstance(method, str):
+            method = (method,)
+        name = method[0]
+        args = list(method[1:])
+        mid = 0.5 * (lb + ub)
+        span = ub - lb
+        if name == "uniform":
+            return self.rng.uniform(lb, ub)
+        if name == "normal":
+            mu = args[0] if len(args) > 0 and args[0] is not None else 0.0
+            kappa = args[1] if len(args) > 1 and args[1] is not None else 1.0
+            off = 0.5 * self.rng.vonmises(mu, kappa, size=self.N_params) / np.pi
+            return mid + off * span
+        if name == "percentile":
+            if not args:
+                raise ValueError("percentile method requires a fraction argument")
+            return lb + float(args[0]) * span
+        raise ValueError(f"unknown sampling method {name!r}")
+
+    def _generate_constrained(self):
+        for key in self._resolution_order():
+            spec = self.Space[key]
+            lb, ub = self._solve_bounds(spec)
+            vals = self._sample_values(lb, ub, spec.get("method", ("uniform",)))
+            kidx = int(np.searchsorted(self.param_keys, key))
+            self.param_arr[:, kidx] = vals
+
+    # ------------------------------------------------------- evolutionary
+
+    def _get_children(self) -> np.ndarray:
+        """SBX/mutation children of a parent population over the
+        unconstrained dimensions (reference :117-225)."""
+        p = dict(self.parents_dict)
+        params = np.asarray(p["params"])
+        values = np.asarray(p["values"], dtype=np.float32)
+        unc_keys = self.param_keys[self.prm_idx_unc]
+        if not np.isin(unc_keys, params).all():
+            raise ValueError("Missing unconstrained params from parents")
+        col = [int(np.where(params == k)[0][0]) for k in unc_keys]
+        unc_values = values[:, col]
+
+        pop_size = int(p.get("pop_size", unc_values.shape[0]))
+        n_children = int(p.get("n_children", self.N_params))
+        crossover_rate = float(p.get("crossover_rate", 0.9))
+        di_crossover = np.asarray(
+            p.get("di_crossover", 1.0), dtype=np.float32
+        )
+        di_mutation = np.asarray(p.get("di_mutation", 20.0), dtype=np.float32)
+        mutation_rate = p.get("mutation_rate", 1.0 / self.prm_unc_dim)
+        xlb = self.unc_intervals[:, 0].astype(np.float32)
+        xub = self.unc_intervals[:, 1].astype(np.float32)
+        n = self.prm_unc_dim
+
+        key = as_key(self.rng)
+        npairs = max(n_children // 2, 1)
+        k_pick, k_op, k_sbx, k_mut = jax.random.split(key, 4)
+        P = min(pop_size, unc_values.shape[0])
+        i1 = jax.random.randint(k_pick, (npairs,), 0, P)
+        i2 = (i1 + jax.random.randint(jax.random.fold_in(k_pick, 1), (npairs,), 1, P)) % P
+        p1 = jnp.asarray(unc_values)[i1]
+        p2 = jnp.asarray(unc_values)[i2]
+        is_x = jax.random.bernoulli(k_op, crossover_rate, (npairs,))
+        di_x = jnp.broadcast_to(jnp.asarray(di_crossover), (n,))
+        di_m = jnp.broadcast_to(jnp.asarray(di_mutation), (n,))
+        c1, c2 = sbx_crossover(k_sbx, p1, p2, di_x, xlb, xub)
+        m1 = polynomial_mutation(k_mut, p1, di_m, xlb, xub, mutation_rate)
+        m2 = polynomial_mutation(
+            jax.random.fold_in(k_mut, 1), p2, di_m, xlb, xub, mutation_rate
+        )
+        o1 = jnp.where(is_x[:, None], c1, m1)
+        o2 = jnp.where(is_x[:, None], c2, m2)
+        X = np.asarray(jnp.concatenate([o1, o2], axis=0))[:n_children]
+        return np.clip(X, xlb, xub)
+
+    # ------------------------------------------------------------- access
+
+    @property
+    def values(self) -> np.ndarray:
+        return self.param_arr
+
+    def as_dict(self) -> Dict[str, np.ndarray]:
+        return {
+            str(k): self.param_arr[:, i] for i, k in enumerate(self.param_keys)
+        }

@@ -412,7 +412,28 @@ def _prepare_training_data(model, xin, yin, nInput, nOutput, xlb, xub, nan, top_
     return X, Yn, y_mean, y_std
 
 
-class GPR_Matern:
+class SurrogateMixin:
+    """Shared surrogate wrapper surface: unit-box x normalization and the
+    reference's ``predict``/``evaluate`` contract on top of a jax-traceable
+    ``predict_normalized`` (shared by the exact-GP and SVGP families)."""
+
+    def normalize_x(self, xin):
+        return (jnp.asarray(xin, jnp.float32) - self.xlb.astype(np.float32)) / (
+            self.xrg.astype(np.float32)
+        )
+
+    def predict(self, xin):
+        x = jnp.atleast_2d(jnp.asarray(xin, jnp.float32))
+        return self.predict_normalized(self.normalize_x(x))
+
+    def evaluate(self, x):
+        mean, var = self.predict(x)
+        if self.return_mean_variance:
+            return mean, var
+        return mean
+
+
+class GPR_Matern(SurrogateMixin):
     """Independent exact GP per objective, Matérn-5/2 kernel.
 
     API-compatible with reference ``GPR_Matern`` (model.py:1182-1275);
@@ -476,22 +497,6 @@ class GPR_Matern:
     def predict_normalized(self, Xq: jax.Array):
         return gp_predict(self.fit, Xq, kernel=self.kernel)
 
-    def normalize_x(self, xin):
-        return (jnp.asarray(xin, jnp.float32) - self.xlb.astype(np.float32)) / (
-            self.xrg.astype(np.float32)
-        )
-
-    def predict(self, xin):
-        x = jnp.atleast_2d(jnp.asarray(xin, jnp.float32))
-        mean, var = self.predict_normalized(self.normalize_x(x))
-        return mean, var
-
-    def evaluate(self, x):
-        mean, var = self.predict(x)
-        if self.return_mean_variance:
-            return mean, var
-        return mean
-
 
 class GPR_RBF(GPR_Matern):
     """RBF-kernel variant (reference model.py:1278-1325)."""
@@ -514,7 +519,7 @@ class EGP_Matern(GPR_Matern):
         super().__init__(*args, n_iter=n_iter, **kwargs)
 
 
-class MEGP_Matern:
+class MEGP_Matern(SurrogateMixin):
     """Multi-output exact GP fit jointly: one shared ARD kernel for all
     objectives, hyperparameters optimized on the SUM of per-objective exact
     MLLs via ``fit_gp_shared``. Capability analog of the reference's
@@ -572,6 +577,3 @@ class MEGP_Matern:
         )
 
     predict_normalized = GPR_Matern.predict_normalized
-    normalize_x = GPR_Matern.normalize_x
-    predict = GPR_Matern.predict
-    evaluate = GPR_Matern.evaluate

@@ -33,6 +33,7 @@ from dmosopt_tpu.models.gp import (
     _KERNELS,
     _Bounds,
     _prepare_training_data,
+    SurrogateMixin,
 )
 from dmosopt_tpu.utils.prng import as_key
 
@@ -60,6 +61,7 @@ class SVGPFit(NamedTuple):
     bounds_ls: _Bounds
     bounds_noise: _Bounds
     elbo: jax.Array
+    kernel: str = "matern52"  # recorded so predict can't mismatch the fit
 
 
 def _tril(M_):
@@ -168,9 +170,15 @@ def fit_svgp(
     Qz = 1 if share_inducing else Q
 
     k_z, k_p, k_b = jax.random.split(as_key(key), 3)
-    # inducing points: random training subset
-    idx = jax.random.choice(k_z, N, (Qz, M), replace=True)
-    Z0 = X[idx]  # (Qz, M, n)
+    # inducing points: distinct random training subset (the full set when
+    # M == N, i.e. VGP)
+    if M == N:
+        Z0 = jnp.broadcast_to(X, (Qz, M, n))
+    else:
+        idx = jax.vmap(
+            lambda k: jax.random.choice(k, N, (M,), replace=False)
+        )(jax.random.split(k_z, Qz))
+        Z0 = X[idx]  # (Qz, M, n)
 
     params = SVGPParams(
         u_amp=jnp.broadcast_to(b_amp.inverse(jnp.asarray(1.0)), (Qk,)),
@@ -208,15 +216,16 @@ def fit_svgp(
         return params, final
 
     params, elbo = train(params, opt_state, k_b)
-    return SVGPFit(params, b_amp, b_ls, b_noise, elbo)
+    return SVGPFit(params, b_amp, b_ls, b_noise, elbo, kernel)
 
 
-def svgp_predict(fit: SVGPFit, Xq, kernel: str = "matern52"):
-    """Posterior mean/variance per output at Xq. Returns ((B, d), (B, d));
-    variance includes the observation noise (consistent with GPR)."""
+def svgp_predict(fit: SVGPFit, Xq):
+    """Posterior mean/variance per output at Xq, using the kernel recorded
+    on the fit. Returns ((B, d), (B, d)); variance includes the
+    observation noise (consistent with GPR)."""
     params = fit.params
     amp, ls, noise = _unpack(params, fit.bounds_amp, fit.bounds_ls, fit.bounds_noise)
-    kernel_fn = _KERNELS[kernel]
+    kernel_fn = _KERNELS[fit.kernel]
     Q = params.vm.shape[0]
     Qk = params.u_amp.shape[0]
     Qz = params.Z.shape[0]
@@ -234,13 +243,13 @@ def svgp_predict(fit: SVGPFit, Xq, kernel: str = "matern52"):
         f_var = (params.W**2) @ variances
     else:
         f_mean, f_var = means, variances
-    return (f_mean + 0.0).T, (f_var + noise[:, None]).T
+    return f_mean.T, (f_var + noise[:, None]).T
 
 
 # ---------------------------------------------------------------- wrappers
 
 
-class _SVGPBase:
+class _SVGPBase(SurrogateMixin):
     """Shared wrapper: reference surrogate interface
     (`predict` -> (mean, var), `evaluate`), unit-box x normalization and
     per-objective y standardization like model.py:1216-1229."""
@@ -309,23 +318,8 @@ class _SVGPBase:
         self.y_std = jnp.asarray(y_std, jnp.float32)
 
     def predict_normalized(self, Xq):
-        mean, var = svgp_predict(self.fit, Xq, kernel=self.kernel)
+        mean, var = svgp_predict(self.fit, Xq)
         return self.y_mean + self.y_std * mean, (self.y_std**2) * var
-
-    def normalize_x(self, xin):
-        return (jnp.asarray(xin, jnp.float32) - self.xlb.astype(np.float32)) / (
-            self.xrg.astype(np.float32)
-        )
-
-    def predict(self, xin):
-        x = jnp.atleast_2d(jnp.asarray(xin, jnp.float32))
-        return self.predict_normalized(self.normalize_x(x))
-
-    def evaluate(self, x):
-        mean, var = self.predict(x)
-        if self.return_mean_variance:
-            return mean, var
-        return mean
 
 
 class VGP_Matern(_SVGPBase):

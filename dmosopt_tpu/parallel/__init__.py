@@ -1,0 +1,12 @@
+from dmosopt_tpu.parallel.evaluator import (  # noqa: F401
+    HostFunEvaluator,
+    JaxBatchEvaluator,
+)
+from dmosopt_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh,
+    initialize_distributed,
+    population_sharding,
+    replicate,
+    shard_population,
+    shard_state,
+)

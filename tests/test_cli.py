@@ -1,0 +1,93 @@
+"""CLI tests: analyze/train/onestep against a real results store
+(reference intent: dmosopt_analyze.py / dmosopt_train.py / dmosopt_onestep.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+click = pytest.importorskip("click")
+from click.testing import CliRunner
+
+import dmosopt_tpu
+from dmosopt_tpu.cli import analyze, onestep, train
+
+N_DIM = 5
+
+
+def zdt1_obj(pp):
+    x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+    f1 = x[0]
+    g = 1.0 + 9.0 / (N_DIM - 1) * np.sum(x[1:])
+    return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    fp = tmp_path_factory.mktemp("cli") / "run.h5"
+    dmosopt_tpu.run(
+        {
+            "opt_id": "cli_run",
+            "obj_fun": zdt1_obj,
+            "objective_names": ["f1", "f2"],
+            "space": {f"x{i}": [0.0, 1.0] for i in range(N_DIM)},
+            "problem_parameters": {},
+            "n_initial": 6,
+            "n_epochs": 2,
+            "population_size": 24,
+            "num_generations": 8,
+            "resample_fraction": 0.5,
+            "surrogate_method_name": "gpr",
+            "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 25, "seed": 0},
+            "random_seed": 9,
+            "save": True,
+            "file_path": str(fp),
+        },
+        verbose=False,
+    )
+    return str(fp)
+
+
+def test_analyze(store, tmp_path):
+    out = tmp_path / "best.json"
+    result = CliRunner().invoke(
+        analyze,
+        ["-p", store, "--opt-id", "cli_run", "--knn", "5",
+         "--output-file", str(out)],
+    )
+    assert result.exit_code == 0, result.output
+    data = json.loads(out.read_text())
+    assert "0" in data and len(data["0"]) >= 1
+    row = next(iter(data["0"].values()))
+    assert set(row["objectives"]) == {"f1", "f2"}
+    assert len(row["parameters"]) == N_DIM
+
+
+def test_train(store, tmp_path):
+    out = tmp_path / "surrogate.joblib"
+    result = CliRunner().invoke(
+        train,
+        ["-p", store, "--opt-id", "cli_run", "-o", str(out),
+         "--surrogate-kwargs", '{"n_starts": 2, "n_iter": 20}'],
+    )
+    assert result.exit_code == 0, result.output
+    import joblib
+
+    sm = joblib.load(out)
+    mean, var = sm.predict(np.full((3, N_DIM), 0.5))
+    assert np.asarray(mean).shape == (3, 2)
+
+
+def test_onestep(store, tmp_path):
+    out = tmp_path / "resample.npz"
+    result = CliRunner().invoke(
+        onestep,
+        ["-p", store, "--opt-id", "cli_run", "--population-size", "16",
+         "--num-generations", "5", "--resample-fraction", "0.5",
+         "-o", str(out),
+         "--surrogate-kwargs", '{"n_starts": 2, "n_iter": 20}'],
+    )
+    assert result.exit_code == 0, result.output
+    data = np.load(out)
+    assert data["x_resample"].shape == (8, N_DIM)
+    assert data["y_pred"].shape == (8, 2)

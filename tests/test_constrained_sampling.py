@@ -1,0 +1,114 @@
+"""Constrained sampling tests (reference semantics:
+dmosopt/constrained_sampling.py, demo dmosopt/test_constrained.py)."""
+
+import numpy as np
+import pytest
+
+from dmosopt_tpu.constrained_sampling import (
+    BoundExpression,
+    ParamSpacePoints,
+    tokenize,
+)
+
+
+def test_expression_parser():
+    env = {"a": np.array([2.0, 4.0]), "b": np.array([10.0, 20.0])}
+    assert BoundExpression("1 + 2 * 3").evaluate({}) == pytest.approx(7.0)
+    assert BoundExpression("2 ** 3").evaluate({}) == pytest.approx(8.0)
+    assert BoundExpression("(1 + 2) * 3").evaluate({}) == pytest.approx(9.0)
+    np.testing.assert_allclose(
+        BoundExpression("a * 2 + 1").evaluate(env), [5.0, 9.0]
+    )
+    np.testing.assert_allclose(
+        BoundExpression("a max 3").evaluate(env), [3.0, 4.0]
+    )
+    np.testing.assert_allclose(
+        BoundExpression("b min 15").evaluate(env), [10.0, 15.0]
+    )
+    with pytest.raises(KeyError):
+        BoundExpression("unknown + 1").evaluate(env)
+    with pytest.raises(ValueError):
+        tokenize("a $ b")
+
+
+def test_reference_demo_space():
+    """The reference's own demo configuration (test_constrained.py:5-26)."""
+    space = {
+        "gc": [0.01, 50],
+        "soma_gnabar": [0.1, 50],
+        "soma_gl": [0.001, 0.6],
+        "soma_gkdrbar": {
+            "abs": [0.0, 60.0],
+            "lb": [("gc", "+ 5")],
+            "ub": [("gc", "+ 10")],
+            "method": ("uniform", None, None),
+        },
+        "soma_gkahpbar": {
+            "abs": [0.001, 0.6],
+            "method": ("normal", 0, 200),
+        },
+    }
+    ps = ParamSpacePoints(50, space, seed=1)
+    vals = ps.as_dict()
+    gc = vals["gc"]
+    gkdr = vals["soma_gkdrbar"]
+    assert np.all(gkdr >= gc + 5 - 1e-9)
+    assert np.all(gkdr <= gc + 10 + 1e-9)
+    gkahp = vals["soma_gkahpbar"]
+    assert np.all((gkahp >= 0.001) & (gkahp <= 0.6))
+    assert np.all(np.isfinite(ps.values))
+
+
+def test_chained_dependency_resolution():
+    space = {
+        "a": [0.0, 1.0],
+        "b": {"abs": [0.0, 10.0], "lb": [("a", "+ 1")], "ub": [("a", "+ 2")],
+              "method": ("uniform",)},
+        "c": {"abs": [0.0, 20.0], "lb": [("b", "* 2")], "ub": [("b", "* 3")],
+              "method": ("percentile", 0.5)},
+    }
+    ps = ParamSpacePoints(20, space, seed=2)
+    v = ps.as_dict()
+    assert np.all(v["b"] >= v["a"] + 1 - 1e-9)
+    assert np.all(v["c"] >= 2 * v["b"] - 1e-9)
+    assert np.all(v["c"] <= 3 * v["b"] + 1e-9)
+    # percentile method is deterministic mid-range
+    np.testing.assert_allclose(v["c"], 2.5 * v["b"], rtol=1e-6)
+
+
+def test_circular_dependency_detected():
+    space = {
+        "a": {"abs": [0, 1], "lb": [("b", "* 1")], "method": ("uniform",)},
+        "b": {"abs": [0, 1], "lb": [("a", "* 1")], "method": ("uniform",)},
+    }
+    with pytest.raises(ValueError, match="circular"):
+        ParamSpacePoints(5, space, seed=0)
+
+
+def test_overconstrained_falls_back_to_abs():
+    space = {
+        "a": [5.0, 6.0],
+        "b": {"abs": [0.0, 1.0], "lb": [("a", "+ 1")], "ub": [("a", "+ 2")],
+              "method": ("uniform",)},
+    }
+    # lb (6..8) clipped into abs [0,1] collapses -> falls back to abs range
+    ps = ParamSpacePoints(10, space, seed=3)
+    b = ps.as_dict()["b"]
+    assert np.all((b >= 0.0) & (b <= 1.0))
+
+
+def test_evolutionary_children():
+    rng = np.random.default_rng(0)
+    parent_vals = rng.uniform(0.2, 0.8, size=(16, 2))
+    space = {"x": [0.0, 1.0], "y": [0.0, 1.0]}
+    ps = ParamSpacePoints(
+        16, space, seed=4,
+        parents={
+            "params": np.array(["x", "y"]),
+            "values": parent_vals,
+            "crossover_rate": 0.9,
+        },
+    )
+    X = ps.values
+    assert X.shape == (16, 2)
+    assert np.all((X >= 0.0) & (X <= 1.0))
