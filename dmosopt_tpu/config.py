@@ -39,6 +39,8 @@ default_surrogate_methods = {
     "gpr": "dmosopt_tpu.models.gp.GPR_Matern",
     "egp": "dmosopt_tpu.models.gp.EGP_Matern",
     "megp": "dmosopt_tpu.models.gp.MEGP_Matern",
+    "mdgp": "dmosopt_tpu.models.deep_gp.MDGP_Matern",
+    "mdspp": "dmosopt_tpu.models.deep_gp.MDSPP_Matern",
     "vgp": "dmosopt_tpu.models.svgp.VGP_Matern",
     "svgp": "dmosopt_tpu.models.svgp.SVGP_Matern",
     "spv": "dmosopt_tpu.models.svgp.SPV_Matern",

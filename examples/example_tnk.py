@@ -1,0 +1,43 @@
+"""TNK constrained two-objective problem with the feasibility-model path
+(capability parity with reference examples/example_dmosopt_tnk.py)."""
+
+import logging
+
+import numpy as np
+
+import dmosopt_tpu
+
+logging.basicConfig(level=logging.INFO)
+
+
+def tnk_obj(pp):
+    """Objectives (x1, x2) with constraints c >= 0 feasible."""
+    x1, x2 = pp["x1"], pp["x2"]
+    c1 = x1**2 + x2**2 - 1.0 - 0.1 * np.cos(16.0 * np.arctan2(x1, x2 + 1e-12))
+    c2 = 0.5 - (x1 - 0.5) ** 2 - (x2 - 0.5) ** 2
+    return np.array([x1, x2]), np.array([c1, c2])
+
+
+if __name__ == "__main__":
+    dmosopt_params = {
+        "opt_id": "dmosopt_tnk",
+        "obj_fun": tnk_obj,
+        "problem_parameters": {},
+        "space": {"x1": [1e-6, np.pi], "x2": [1e-6, np.pi]},
+        "objective_names": ["f1", "f2"],
+        "constraint_names": ["c1", "c2"],
+        "feasibility_method_name": "logreg",
+        "population_size": 100,
+        "num_generations": 50,
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "n_initial": 20,
+        "n_epochs": 4,
+        "resample_fraction": 0.5,
+        "random_seed": 1,
+    }
+
+    best = dmosopt_tpu.run(dmosopt_params, verbose=True, return_constraints=True)
+    prms, lres, lconstr = best
+    c = np.column_stack([v for _, v in lconstr])
+    print(f"{c.shape[0]} best points, all feasible: {bool(np.all(c > 0))}")

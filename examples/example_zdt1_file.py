@@ -1,0 +1,49 @@
+"""ZDT1 with HDF5 persistence and resume (capability parity with
+reference examples/example_dmosopt_zdt1_file.py): run once, then run
+again with the same file to continue from the stored state."""
+
+import logging
+import os
+
+import numpy as np
+
+import dmosopt_tpu
+
+logging.basicConfig(level=logging.INFO)
+
+N = 10
+
+
+def obj_fun(pp):
+    x = np.array([pp[f"x{i + 1}"] for i in range(N)])
+    f1 = x[0]
+    g = 1.0 + 9.0 / (N - 1) * np.sum(x[1:])
+    return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
+
+
+if __name__ == "__main__":
+    os.makedirs("results", exist_ok=True)
+    dmosopt_params = {
+        "opt_id": "dmosopt_zdt1_file",
+        "obj_fun": obj_fun,
+        "problem_parameters": {},
+        "space": {f"x{i + 1}": [0.0, 1.0] for i in range(N)},
+        "objective_names": ["y1", "y2"],
+        "population_size": 100,
+        "num_generations": 50,
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "n_initial": 5,
+        "n_epochs": 2,
+        "save": True,
+        "save_eval": 10,
+        "save_surrogate_evals": True,
+        "file_path": "results/zdt1.h5",
+        "random_seed": 21,
+    }
+
+    dmosopt_tpu.run(dmosopt_params, verbose=True)
+    print("first run complete; resuming 2 more epochs from results/zdt1.h5")
+    best = dmosopt_tpu.run(dmosopt_params, verbose=True)
+    print("analyze with: python -m dmosopt_tpu.cli analyze "
+          "-p results/zdt1.h5 --opt-id dmosopt_zdt1_file --knn 5")

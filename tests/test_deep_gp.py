@@ -1,0 +1,86 @@
+"""Deep-kernel GP surrogate tests (capability analog of the reference
+deep GP / DSPP models, model_gpytorch.py:991-1620) and early stopping."""
+
+import numpy as np
+import pytest
+
+from dmosopt_tpu.models.deep_gp import MDGP_Matern, MDSPP_Matern
+from dmosopt_tpu.models.early_stopping import (
+    AdaptiveEarlyStopping,
+    EarlyStoppingConfig,
+    ModelType,
+    analyze_loss_trajectory,
+    suggest_hyperparameters,
+)
+
+
+def _nonstationary_data(n=250, seed=0):
+    """Frequency doubles across the domain: stationary GPs struggle."""
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(size=(n, 2))
+    t = X[:, 0]
+    y0 = np.sin(2 * np.pi * t * (1 + 3 * t))
+    y1 = np.cos(4 * np.pi * X[:, 1] ** 2)
+    Y = np.column_stack([y0, y1]) + 0.01 * rng.normal(size=(n, 2))
+    return X, Y
+
+
+@pytest.mark.parametrize("cls", [MDGP_Matern, MDSPP_Matern])
+def test_deep_gp_fits_nonstationary(cls):
+    X, Y = _nonstationary_data()
+    m = cls(X, Y, 2, 2, np.zeros(2), np.ones(2), seed=0, n_iter=300)
+    mean, var = m.predict(X[:100])
+    mean = np.asarray(mean)
+    assert mean.shape == (100, 2)
+    assert np.all(np.asarray(var) > 0)
+    resid = np.mean((mean - Y[:100]) ** 2, axis=0)
+    assert np.all(resid < 0.3 * np.var(Y, axis=0)), resid
+
+
+def test_deep_gp_in_registry():
+    from dmosopt_tpu.config import default_surrogate_methods, resolve
+
+    assert resolve("mdgp", default_surrogate_methods) is MDGP_Matern
+    assert resolve("mdspp", default_surrogate_methods) is MDSPP_Matern
+
+
+def test_early_stopping_converged_loss():
+    cfg = EarlyStoppingConfig(
+        min_iterations=10, window_size=20, patience=2,
+        threshold_pct=0.5, absolute_tolerance=1e-3,
+    )
+    stopper = AdaptiveEarlyStopping(cfg)
+    flat = np.full(100, 1.2345)
+    stopped = False
+    for it in range(50, 100):
+        stop, reason = stopper.should_stop(it, flat[:it])
+        if stop:
+            stopped = True
+            assert reason
+            break
+    assert stopped
+
+
+def test_early_stopping_keeps_running_on_progress():
+    cfg = EarlyStoppingConfig(min_iterations=10, window_size=20, patience=2)
+    stopper = AdaptiveEarlyStopping(cfg)
+    falling = 100.0 * np.exp(-0.05 * np.arange(200))
+    for it in range(30, 100):
+        stop, _ = stopper.should_stop(it, falling[:it])
+        assert not stop
+
+
+def test_trajectory_analysis_and_suggestions():
+    falling = 100.0 * np.exp(-0.05 * np.arange(400))
+    stats = analyze_loss_trajectory(falling)
+    assert stats["monotonic_decrease"]
+    assert stats["final_loss"] < stats["mean_loss"]
+
+    osc = 10 + np.sin(np.arange(300))
+    stats_osc = analyze_loss_trajectory(osc)
+    rec = suggest_hyperparameters(stats_osc, ModelType.DEEP_GP)
+    assert rec.get("learning_rate") == "decrease"
+
+    assert (
+        EarlyStoppingConfig.for_model_type(ModelType.EXACT_GP).window_size == 200
+    )
