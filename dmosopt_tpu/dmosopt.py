@@ -1,0 +1,17 @@
+"""Drop-in style entry module: `from dmosopt_tpu import dmosopt`.
+
+Mirrors the reference's primary import surface (`from dmosopt import
+dmosopt; dmosopt.run(...)`, reference dmosopt/dmosopt.py:2501) so
+migrating callers only change the package name. Everything here
+re-exports the driver implementation.
+"""
+
+from dmosopt_tpu.driver import (  # noqa: F401
+    DistOptimizer,
+    dopt_dict,
+    dopt_init,
+    eval_obj_fun_mp,
+    eval_obj_fun_sp,
+    run,
+)
+from dmosopt_tpu.strategy import DistOptStrategy  # noqa: F401

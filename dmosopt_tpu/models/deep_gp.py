@@ -33,10 +33,15 @@ from dmosopt_tpu.models.gp import (
     _Bounds,
     _KERNELS,
     _prepare_training_data,
+    _regularized_kernel,
+)
+from dmosopt_tpu.models.early_stopping import (
+    AdaptiveEarlyStopping,
+    EarlyStoppingConfig,
+    ModelType,
 )
 from dmosopt_tpu.utils.prng import as_key
 
-_JITTER = 1e-5
 _LOG2PI = math.log(2.0 * math.pi)
 
 
@@ -55,6 +60,7 @@ class DeepGPParams(NamedTuple):
 class DeepGPFit(NamedTuple):
     params: DeepGPParams
     X: jax.Array  # (N, n) training inputs (unit box)
+    F: jax.Array  # (N, k) warped training features (cached at fit time)
     L: jax.Array  # (d, N, N) Cholesky factors on warped features
     alpha: jax.Array  # (d, N)
     y_mean: jax.Array
@@ -90,8 +96,10 @@ def _mlp_forward(mlp: MLPParams, X):
 
 def _nmll_on_features(F, y, amp, ls, noise, kernel_fn):
     N = F.shape[0]
-    K = kernel_fn(F, F, ls, amp) + (noise + _JITTER * amp) * jnp.eye(N)
-    K = 0.5 * (K + K.T)
+    # shared f32-safe regularization (models/gp.py:117-131); the MLP warp
+    # can collapse inputs to near-duplicate features, so the amplitude-
+    # relative jitter matters even more here
+    K = _regularized_kernel(F, ls, amp, noise, kernel_fn)
     L = jnp.linalg.cholesky(K)
     a = jax.scipy.linalg.solve_triangular(L, y, lower=True)
     logdet = 2.0 * jnp.sum(jnp.log(jnp.maximum(jnp.diag(L), 1e-20)))
@@ -112,6 +120,7 @@ def fit_deep_gp(
     n_iter: int = 500,
     learning_rate: float = 0.01,
     batch_size: Optional[int] = None,
+    early_stopping: bool = False,
 ) -> DeepGPFit:
     """Joint Adam training of MLP warp + per-objective exact GP on the
     warped features. With `batch_size`, the NMLL is estimated on random
@@ -154,9 +163,7 @@ def fit_deep_gp(
     opt = optax.adam(learning_rate)
 
     @jax.jit
-    def train(params, key):
-        opt_state = opt.init(params)
-
+    def train_chunk(params, opt_state, keys):
         def step(carry, k):
             params, opt_state = carry
             if B < N:
@@ -169,12 +176,42 @@ def fit_deep_gp(
             params = optax.apply_updates(params, updates)
             return (params, opt_state), loss
 
-        keys = jax.random.split(key, n_iter)
-        (params, _), losses = jax.lax.scan(step, (params, opt_state), keys)
-        return params, losses
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), keys
+        )
+        return params, opt_state, losses
+
+    # chunked training: one host early-stopping check per chunk, not per
+    # iteration (models/early_stopping.py)
+    stopper = None
+    if early_stopping:
+        cfg = EarlyStoppingConfig.for_model_type(
+            ModelType.DEEP_STOCHASTIC if batch_size else ModelType.DEEP_GP
+        )
+        cfg.min_iterations = min(cfg.min_iterations, n_iter // 2)
+        cfg.window_size = min(cfg.window_size, max(n_iter // 4, 10))
+        stopper = AdaptiveEarlyStopping(cfg)
 
     key, k_train = jax.random.split(key)
-    params, losses = train(params, k_train)
+    opt_state = opt.init(params)
+    chunk = n_iter if stopper is None else max(n_iter // 8, 25)
+    loss_hist = []
+    done = 0
+    while done < n_iter:
+        n_chunk = min(chunk, n_iter - done)
+        k_train, k = jax.random.split(k_train)
+        params, opt_state, losses_c = train_chunk(
+            params, opt_state, jax.random.split(k, n_chunk)
+        )
+        loss_hist.append(np.asarray(losses_c))
+        done += n_chunk
+        if stopper is not None:
+            stop, _reason = stopper.should_stop(
+                done, np.concatenate(loss_hist)
+            )
+            if stop:
+                break
+    losses = jnp.asarray(np.concatenate(loss_hist))
 
     # posterior cache on the full training set
     @jax.jit
@@ -185,8 +222,7 @@ def fit_deep_gp(
         noise = b_noise.forward(params.u_noise)
 
         def one(a, l, s, y):
-            K = kernel_fn(F, F, l, a) + (s + _JITTER * a) * jnp.eye(N)
-            K = 0.5 * (K + K.T)
+            K = _regularized_kernel(F, l, a, s, kernel_fn)
             L = jnp.linalg.cholesky(K)
             alpha = jax.scipy.linalg.cho_solve((L, True), y)
             return L, alpha
@@ -198,6 +234,7 @@ def fit_deep_gp(
     return DeepGPFit(
         params=params,
         X=X,
+        F=_mlp_forward(params.mlp, X),
         L=Ls,
         alpha=alphas,
         y_mean=jnp.zeros((d,)),
@@ -209,11 +246,16 @@ def fit_deep_gp(
     )
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("kernel",))
 def deep_gp_predict(fit: DeepGPFit, Xq, kernel: str = "matern52"):
-    """Posterior mean/variance at query points. Returns ((M, d), (M, d))."""
+    """Posterior mean/variance at query points. Returns ((M, d), (M, d)).
+    Uses the warped training features cached on the fit."""
     kernel_fn = _KERNELS[kernel]
     params = fit.params
-    F_train = _mlp_forward(params.mlp, fit.X)
+    F_train = fit.F
     F_q = _mlp_forward(params.mlp, Xq)
     amp = fit.bounds_amp.forward(params.u_amp)
     ls = fit.bounds_ls.forward(params.u_ls)
@@ -253,6 +295,7 @@ class MDGP_Matern(SurrogateMixin):
         n_iter: int = 500,
         learning_rate: float = 0.01,
         batch_size: Optional[int] = None,
+        early_stopping: bool = False,
         anisotropic: bool = False,
         return_mean_variance: bool = False,
         nan: Optional[str] = "remove",
@@ -276,6 +319,7 @@ class MDGP_Matern(SurrogateMixin):
             n_iter=n_iter,
             learning_rate=learning_rate,
             batch_size=batch_size or self.default_batch_size,
+            early_stopping=early_stopping,
         )
         self.fit = fit._replace(
             y_mean=jnp.asarray(y_mean, jnp.float32),

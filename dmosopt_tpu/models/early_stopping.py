@@ -79,6 +79,13 @@ class AdaptiveEarlyStopping:
         loss_history: np.ndarray,
         compute_validation: Optional[Callable[[], float]] = None,
     ) -> Tuple[bool, str]:
+        # gate first: below warmup/min_iterations nothing is consulted, so
+        # no window scans or validation evaluations are wasted
+        if iteration < max(
+            self.config.min_iterations, self.config.warmup_iterations
+        ):
+            return False, ""
+
         loss_history = np.asarray(loss_history)
         checks = [
             self._check_percentage_change(loss_history),
@@ -88,9 +95,6 @@ class AdaptiveEarlyStopping:
         ]
         if compute_validation is not None:
             checks.append(self._check_validation_loss(compute_validation))
-
-        if iteration < self.config.min_iterations:
-            return False, ""
 
         criteria_met = sum(stop for stop, _ in checks)
         if criteria_met >= 2:  # at least 2 criteria must agree
@@ -198,7 +202,12 @@ def suggest_hyperparameters(loss_trajectory: dict, model_type: ModelType) -> dic
         rec["n_iter"] = "increase"
         rec["reason_n_iter"] = "Model has not converged"
     conv = loss_trajectory.get("convergence_iteration")
-    if conv is not None and conv < 500 and loss_trajectory.get("final_loss", 0) > 1.0:
+    if (
+        conv is not None
+        and conv < 500
+        and loss_trajectory.get("final_loss", 0) > 1.0
+        and "learning_rate" not in rec  # don't contradict the oscillation advice
+    ):
         rec["learning_rate"] = "increase"
         rec["reason_lr"] = "Converged too early, try higher learning rate"
     if model_type in (ModelType.DEEP_GP, ModelType.DEEP_STOCHASTIC):

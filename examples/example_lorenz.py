@@ -58,10 +58,16 @@ TARGET = integrate_lorenz(TRUE_P, N_STEPS)[SKIP::TARGET_STRIDE]
 
 def lorenz_objectives(P):
     """Batched objective: (B, 3) parameter sets -> (B, 3) per-axis mean
-    absolute trajectory errors."""
+    absolute trajectory errors.
+
+    The driver flattens the space in sorted-key order, so columns arrive
+    as (b, r, s); reorder to the (s, r, b) convention of the RHS."""
 
     def one(p):
-        traj = integrate_lorenz(p, N_STEPS)[SKIP::TARGET_STRIDE]
+        b, r, s = p
+        traj = integrate_lorenz(jnp.asarray([s, r, b]), N_STEPS)[
+            SKIP::TARGET_STRIDE
+        ]
         return jnp.mean(jnp.abs(traj - TARGET), axis=0)
 
     return jax.vmap(one)(P)

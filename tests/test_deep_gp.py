@@ -48,6 +48,7 @@ def test_early_stopping_converged_loss():
     cfg = EarlyStoppingConfig(
         min_iterations=10, window_size=20, patience=2,
         threshold_pct=0.5, absolute_tolerance=1e-3,
+        warmup_iterations=10,  # checks are gated on max(min_iter, warmup)
     )
     stopper = AdaptiveEarlyStopping(cfg)
     flat = np.full(100, 1.2345)
@@ -62,7 +63,9 @@ def test_early_stopping_converged_loss():
 
 
 def test_early_stopping_keeps_running_on_progress():
-    cfg = EarlyStoppingConfig(min_iterations=10, window_size=20, patience=2)
+    cfg = EarlyStoppingConfig(
+        min_iterations=10, window_size=20, patience=2, warmup_iterations=10
+    )
     stopper = AdaptiveEarlyStopping(cfg)
     falling = 100.0 * np.exp(-0.05 * np.arange(200))
     for it in range(30, 100):

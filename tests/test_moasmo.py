@@ -186,3 +186,22 @@ def test_epsilon_get_best():
     # [2,2] is dominated; [0,1] and [0.01,0.99] share an epsilon box -> one kept
     assert by.shape[0] == 2
     assert not np.any(np.all(by == np.array([2.0, 2.0]), axis=1))
+
+
+def test_dmosopt_alias_module_and_profiling():
+    """Drop-in import surface + phase-timer stats convention."""
+    from dmosopt_tpu import dmosopt as alias
+    from dmosopt_tpu.driver import run as real_run
+    from dmosopt_tpu.utils.profiling import eval_time_stats, phase_timer
+
+    assert alias.run is real_run
+    assert alias.DistOptimizer is not None
+
+    stats = {}
+    with phase_timer(stats, "init_sampling"):
+        pass
+    assert stats["init_sampling_end"] >= stats["init_sampling_start"]
+
+    agg = eval_time_stats([0.5, 1.5, -1.0])
+    assert agg["eval_mean"] == pytest.approx(1.0)
+    assert eval_time_stats([-1.0])["eval_mean"] == -1.0
