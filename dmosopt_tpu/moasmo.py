@@ -135,9 +135,8 @@ def _optimize_on_device(
     state = optimizer.state
 
     if not getattr(optimizer, "jit_compatible", True):
-        # optimizers with host-side selection (EHVI mid-front breaking in
-        # CMAES/TRS) run a per-generation host loop; the surrogate predict
-        # and their inner kernels are still jitted
+        # escape hatch for user-registered optimizers with host-side state:
+        # a per-generation host loop (all built-in optimizers are scannable)
         return _optimize_host_loop(
             optimizer, eval_fn, num_generations, termination, logger
         )

@@ -4,37 +4,46 @@ Algorithm semantics follow the reference (dmosopt/CMAES.py:23-537), after
 Suttorp/Hansen/Igel 2009 and Voss/Hansen/Igel 2010: per-individual step
 sizes and Cholesky factors; generation via ``parent + sigma * A @ z``;
 success-rate step-size adaptation; survival fills non-dominated fronts
-and breaks the mid front by expected hypervolume improvement.
+and breaks the mid front by hypervolume improvement.
 
-TPU split: the per-offspring state updates (success-probability, step
-size, rank-1 Cholesky update of A and A^-1) are batched — one vmapped
-jit over all chosen offspring (`_update_cholesky_batch`, replacing the
-reference's per-individual Python loop CMAES.py:345-397) — and EHVI
-scoring runs on device (`dmosopt_tpu.hv.ehvi_batch`). The front-fill
-selection itself is data-dependent (variable front sizes, top-k on the
-mid front) and stays host-side; `jit_compatible = False` routes the
-epoch engine to its host generation loop.
+TPU redesign: the whole generation — offspring sampling, survival
+selection, success bookkeeping, rank-1 Cholesky updates — is pure
+functions over a fixed-shape state pytree, so the generation loop runs
+under ``lax.scan`` (``jit_compatible = True``; the reference runs a
+Python loop with per-individual updates, CMAES.py:345-397):
 
-Redesign note: the reference rescales offspring by the global max
+- survival selection is the masked on-device front fill of
+  `ehvi_select.front_fill_selection` (the reference's host loop over
+  fronts + exact EHVI with unit variances);
+- the per-parent success/failure bookkeeping — the reference applies
+  psucc/sigma updates sequentially, all successes then all failures —
+  is replaced by its closed form: with m successes then f failures and
+  q = 1-cp, psucc' = q^f (1 + q^m (psucc - 1)) and the accumulated
+  log-sigma exponent is the geometric-series sum of the psucc
+  trajectory; m and f come from one segment-sum over offspring;
+- the rank-1 Cholesky updates of all offspring run as one batched
+  einsum program (`_update_cholesky_batch`).
+
+Redesign notes: the reference rescales offspring by the global max
 absolute coordinate (CMAES.py:269-270), which distorts the sampling
-distribution; here offspring are clipped to bounds instead.
+distribution; here offspring are clipped to bounds. The reference's
+optional feasibility-rank tie-break inside fronts (CMAES.py:456-487)
+is not applied on the scan path (rank-only ordering).
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from dmosopt_tpu.optimizers.base import MOEA, Struct
-from dmosopt_tpu.indicators import HypervolumeImprovement, PopulationDiversity
+from dmosopt_tpu.optimizers.base import MOEA
+from dmosopt_tpu.optimizers.ehvi_select import front_fill_selection
 from dmosopt_tpu.moasmo import remove_duplicates
-from dmosopt_tpu.optimizers.ehvi_select import ehvi_front_selection
 from dmosopt_tpu.ops import non_dominated_rank, sort_mo
-from dmosopt_tpu.utils.prng import as_generator
 
 
 @partial(jax.jit, static_argnames=())
@@ -72,8 +81,22 @@ def _update_cholesky_batch(A, Ainv, z, psucc, pc, cc, ccov, pthresh):
     return A, Ainv, pc
 
 
+class CMAESState(NamedTuple):
+    bounds: jax.Array  # (n, 2)
+    parents_x: jax.Array  # (P, n)
+    parents_y: jax.Array  # (P, d)
+    sigmas: jax.Array  # (P, n)
+    A: jax.Array  # (P, n, n)
+    Ainv: jax.Array  # (P, n, n)
+    pc: jax.Array  # (P, n)
+    psucc: jax.Array  # (P,)
+    rank: jax.Array  # (P,)
+    gen_pidx: jax.Array  # (C,) parent index of each offspring this gen
+    sel_key: jax.Array  # PRNG key for selection MC scoring
+
+
 class CMAES(MOEA):
-    jit_compatible = False  # host-side front-fill + EHVI selection
+    jit_compatible = True
 
     def __init__(
         self,
@@ -89,16 +112,11 @@ class CMAES(MOEA):
             name="CMAES", popsize=popsize, nInput=nInput, nOutput=nOutput, **kwargs
         )
         self.model = model
-        self.x_distance_metrics = None
-        feasibility = getattr(model, "feasibility", None) if model is not None else None
-        if feasibility is not None:
-            self.x_distance_metrics = [feasibility.rank]
         di_mutation = self.opt_params.di_mutation
         if np.isscalar(di_mutation):
             self.opt_params.di_mutation = np.asarray([di_mutation] * nInput)
-        self.indicator = HypervolumeImprovement
         self.optimize_mean_variance = optimize_mean_variance
-        self.diversity_indicator = PopulationDiversity()
+        self.n_offspring = self.opt_params.lambda_ * self.opt_params.mu
 
     @property
     def default_parameters(self) -> Dict[str, Any]:
@@ -116,174 +134,139 @@ class CMAES(MOEA):
             "ccov": 2.0 / (nInput**2 + 6.0),
             "pthresh": 0.44,
             "di_mutation": 30.0,
+            "selection_mc_samples": 4096,
             "max_population_size": 600,
             "min_population_size": 100,
             "adaptive_population_size": False,
         }
 
-    # --------------------------------------------------------- host API
-    # (overrides the jitted base-class paths: selection is host-side)
+    # ----------------------------------------------------- pure functions
 
-    def initialize_strategy(self, x, y, bounds, random=None, **params):
-        self.bounds = np.asarray(bounds, dtype=np.float32)
-        self.local_random = as_generator(random)
+    def initialize_state(self, key, x, y, bounds) -> CMAESState:
         dim = self.nInput
         P = self.popsize
-        sigma = self.opt_params.sigma
-        di_mutation = np.asarray(self.opt_params.di_mutation, dtype=np.float32)
-        ptarg = self.opt_params.ptarg
-
-        sigmas = np.tile(sigma * (1.0 / (di_mutation + 1.0)), (P, 1)).astype(
-            np.float32
-        )
-        A = np.tile(np.identity(dim, dtype=np.float32), (P, 1, 1))
-        Ainv = A.copy()
-        pc = np.zeros((P, dim), dtype=np.float32)
-        psucc = np.full((P,), ptarg, dtype=np.float32)
-
-        order, rank = self._sort(x, y)
-        idx = order[:P]
-        self.state = Struct(
-            bounds=self.bounds,
-            parents_x=np.asarray(x, np.float32)[idx],
-            parents_y=np.asarray(y, np.float32)[idx],
-            sigmas=sigmas,
-            A=A,
-            Ainv=Ainv,
-            pc=pc,
-            psucc=psucc,
-            rank=np.asarray(rank)[idx],
-        )
-        return self.state
-
-    def _sort(self, x, y):
-        """Rank + permutation with optional x-distance tie-break within
-        fronts (reference CMAES.py:456-487)."""
-        rank = np.asarray(non_dominated_rank(jnp.asarray(y, jnp.float32)))
-        x = np.asarray(x)
-        x_dists = []
-        if self.x_distance_metrics:
-            for fn in self.x_distance_metrics:
-                dist = np.zeros_like(rank, dtype=np.float64)
-                for front in range(int(rank.max()) + 1):
-                    sel = rank == front
-                    dist[sel] = np.asarray(fn(x[sel, :])).ravel()
-                x_dists.append(dist)
-        perm = np.lexsort(tuple([-d for d in x_dists] + [rank]))
-        return perm, rank
-
-    def generate(self, **params):
-        dim = self.nInput
-        mu = self.opt_params.mu
-        lambda_ = self.opt_params.lambda_
-        rng = self.local_random
-        st = self.state
-
-        arz = rng.normal(size=(lambda_ * mu, dim)).astype(np.float32)
-        order, rank = self._sort(st.parents_x, st.parents_y)
-        # parents = the best mu by front order (reference CMAES.py:246-258)
-        parent_selection = order[:mu]
-        js = rng.choice(len(parent_selection), size=lambda_ * mu)
-        p_idx = parent_selection[js]
-        steps = st.sigmas[p_idx] * np.einsum("ijk,ik->ij", st.A[p_idx], arz)
-        individuals = st.parents_x[p_idx] + steps
-        x_new = np.clip(individuals, self.bounds[:, 0], self.bounds[:, 1])
-        return x_new.astype(np.float32), {"p_idx": p_idx}
-
-    generate_strategy = None  # host-loop optimizer
-
-    def _select(self, candidates_x, candidates_y):
-        """Front-fill + EHVI mid-front selection
-        (reference CMAES.py:167-230, shared with TRS)."""
-        return ehvi_front_selection(candidates_y, self.popsize, self.indicator)
-
-    def update(self, x_gen, y_gen, state=None, **params):
-        st = self.state
         opt = self.opt_params
-        dim = self.nInput
-        p_idxs = np.asarray((state or {})["p_idx"])
-        xlb, xub = self.bounds[:, 0], self.bounds[:, 1]
+        rank = non_dominated_rank(y)
+        order = jnp.argsort(rank, stable=True)
+        idx = order[jnp.arange(P) % x.shape[0]]
 
-        x_gen = np.asarray(x_gen, np.float32)
-        y_gen = np.asarray(y_gen, np.float32)
-        P = st.parents_x.shape[0]
-        C = x_gen.shape[0]
-        candidates_x = np.vstack((x_gen, st.parents_x))
-        candidates_y = np.vstack((y_gen, st.parents_y))
-        is_offspring = np.concatenate(
-            (np.ones(C, dtype=bool), np.zeros(P, dtype=bool))
+        di_mutation = jnp.asarray(opt.di_mutation, jnp.float32)
+        sigmas = jnp.tile(
+            (opt.sigma * (1.0 / (di_mutation + 1.0)))[None, :], (P, 1)
         )
-        cand_pidx = np.concatenate((p_idxs, np.arange(P)))
-        chosen, not_chosen, rank = self._select(candidates_x, candidates_y)
+        eye = jnp.tile(jnp.eye(dim, dtype=jnp.float32)[None], (P, 1, 1))
+        return CMAESState(
+            bounds=bounds,
+            parents_x=x[idx],
+            parents_y=y[idx],
+            sigmas=sigmas,
+            A=eye,
+            Ainv=eye,
+            pc=jnp.zeros((P, dim), jnp.float32),
+            psucc=jnp.full((P,), opt.ptarg, jnp.float32),
+            rank=rank[idx],
+            gen_pidx=jnp.zeros((self.n_offspring,), jnp.int32),
+            sel_key=key,
+        )
 
+    def generate_strategy(self, key, state: CMAESState):
+        C = self.n_offspring
+        mu = self.opt_params.mu
+        k_pick, k_z = jax.random.split(key)
+
+        # parents = the best mu by front order (reference CMAES.py:246-258)
+        order = jnp.argsort(state.rank, stable=True)
+        js = jax.random.randint(k_pick, (C,), 0, mu)
+        p_idx = order[js]
+
+        z = jax.random.normal(k_z, (C, self.nInput), jnp.float32)
+        steps = state.sigmas[p_idx] * jnp.einsum("ijk,ik->ij", state.A[p_idx], z)
+        x_new = state.parents_x[p_idx] + steps
+        x_new = jnp.clip(x_new, state.bounds[:, 0], state.bounds[:, 1])
+        return x_new, state._replace(gen_pidx=p_idx)
+
+    def update_strategy(self, state: CMAESState, x_gen, y_gen) -> CMAESState:
+        opt = self.opt_params
+        P = self.popsize
+        C = self.n_offspring
         cp, cc, ccov = opt.cp, opt.cc, opt.ccov
         d, ptarg, pthresh = opt.d, opt.ptarg, opt.pthresh
+        xlb, xub = state.bounds[:, 0], state.bounds[:, 1]
+        pidx = state.gen_pidx
 
-        # per-offspring copies of parent strategy parameters
-        sigmas = st.sigmas[cand_pidx].copy()
-        last_steps = sigmas.copy()
-        A = st.A[cand_pidx].copy()
-        Ainv = st.Ainv[cand_pidx].copy()
-        pc = st.pc[cand_pidx].copy()
-        psucc = st.psucc[cand_pidx].copy()
+        cand_y = jnp.concatenate([y_gen, state.parents_y], axis=0)
+        sel_key, k = jax.random.split(state.sel_key)
+        sel_idx, chosen, rank = front_fill_selection(
+            k, cand_y, P, n_samples=opt.selection_mc_samples
+        )
+        chosen_off = chosen[:C]
 
-        # chosen offspring: success update + batched Cholesky update
-        # (vectorized; per-offspring copies are independent)
-        co = np.flatnonzero(chosen & is_offspring)
-        if len(co) > 0:
-            psucc[co] = (1.0 - cp) * psucc[co] + cp
-            sigmas[co] = sigmas[co] * np.exp(
-                (psucc[co, None] - ptarg) / (d * (1.0 - ptarg))
-            )
-            z = (
-                (candidates_x[co] - st.parents_x[cand_pidx[co]])
-                / (xub - xlb)
-                / last_steps[co]
-            )
-            A_new, Ainv_new, pc_new = _update_cholesky_batch(
-                jnp.asarray(A[co]),
-                jnp.asarray(Ainv[co]),
-                jnp.asarray(z, jnp.float32),
-                jnp.asarray(psucc[co]),
-                jnp.asarray(pc[co]),
-                cc,
-                ccov,
-                pthresh,
-            )
-            A[co] = np.asarray(A_new)
-            Ainv[co] = np.asarray(Ainv_new)
-            pc[co] = np.asarray(pc_new)
+        # --- offspring strategy parameters, as if chosen (unchosen ones are
+        # never gathered): one success update on the parent's copies
+        last = state.sigmas[pidx]
+        psucc_off = (1.0 - cp) * state.psucc[pidx] + cp
+        sig_off = last * jnp.exp(
+            (psucc_off[:, None] - ptarg) / (d * (1.0 - ptarg))
+        )
+        z_eff = (x_gen - state.parents_x[pidx]) / (xub - xlb) / last
+        A_off, Ainv_off, pc_off = _update_cholesky_batch(
+            state.A[pidx],
+            state.Ainv[pidx],
+            z_eff,
+            psucc_off,
+            state.pc[pidx],
+            cc,
+            ccov,
+            pthresh,
+        )
 
-        # parent bookkeeping: all successes first, then failures
-        # (reference event order, CMAES.py:345-397)
-        for ind in co:
-            p = cand_pidx[ind]
-            st.psucc[p] = (1.0 - cp) * st.psucc[p] + cp
-            st.sigmas[p] = st.sigmas[p] * np.exp(
-                (st.psucc[p] - ptarg) / (d * (1.0 - ptarg))
-            )
-        for ind in np.flatnonzero(not_chosen & is_offspring):
-            p = cand_pidx[ind]
-            st.psucc[p] = (1.0 - cp) * st.psucc[p]
-            st.sigmas[p] = st.sigmas[p] * np.exp(
-                (st.psucc[p] - ptarg) / (d * (1.0 - ptarg))
-            )
+        # --- parent bookkeeping in closed form. The reference applies the
+        # psucc/sigma recurrences sequentially per event, all successes
+        # first then all failures (CMAES.py:345-397); with m successes,
+        # f failures and q = 1-cp the trajectory is geometric:
+        #   psucc' = q^f (1 + q^m (psucc - 1))
+        #   sum of psucc over the trajectory = S1 + S2 (below)
+        m = jax.ops.segment_sum(
+            chosen_off.astype(jnp.float32), pidx, num_segments=P
+        )
+        f = jax.ops.segment_sum(
+            (~chosen_off).astype(jnp.float32), pidx, num_segments=P
+        )
+        q = 1.0 - cp
+        qm = q**m
+        qf = q**f
+        p0 = state.psucc
+        p_s = 1.0 + qm * (p0 - 1.0)  # after the successes
+        psucc_par = qf * p_s
+        S1 = m + (p0 - 1.0) * q * (1.0 - qm) / cp
+        S2 = p_s * q * (1.0 - qf) / cp
+        sig_par = state.sigmas * jnp.exp(
+            ((S1 + S2 - (m + f) * ptarg) / (d * (1.0 - ptarg)))[:, None]
+        )
 
-        sel_off = is_offspring[chosen]
-        sel_pidx = cand_pidx[chosen]
-        st.parents_x = candidates_x[chosen]
-        st.parents_y = candidates_y[chosen]
-        st.rank = rank[chosen]
-        st.sigmas = np.where(sel_off[:, None], sigmas[chosen], st.sigmas[sel_pidx])
-        st.A = np.where(sel_off[:, None, None], A[chosen], st.A[sel_pidx])
-        st.Ainv = np.where(sel_off[:, None, None], Ainv[chosen], st.Ainv[sel_pidx])
-        st.pc = np.where(sel_off[:, None], pc[chosen], st.pc[sel_pidx])
-        st.psucc = np.where(sel_off, psucc[chosen], st.psucc[sel_pidx])
-        return st
+        # --- gather the survivors (offspring rows first, parents after)
+        cand_x = jnp.concatenate([x_gen, state.parents_x], axis=0)
+        cand_sig = jnp.concatenate([sig_off, sig_par], axis=0)
+        cand_psucc = jnp.concatenate([psucc_off, psucc_par], axis=0)
+        cand_A = jnp.concatenate([A_off, state.A], axis=0)
+        cand_Ainv = jnp.concatenate([Ainv_off, state.Ainv], axis=0)
+        cand_pc = jnp.concatenate([pc_off, state.pc], axis=0)
+
+        return state._replace(
+            parents_x=cand_x[sel_idx],
+            parents_y=cand_y[sel_idx],
+            sigmas=cand_sig[sel_idx],
+            A=cand_A[sel_idx],
+            Ainv=cand_Ainv[sel_idx],
+            pc=cand_pc[sel_idx],
+            psucc=cand_psucc[sel_idx],
+            rank=rank[sel_idx],
+            sel_key=sel_key,
+        )
 
     def get_population_strategy(self, state=None):
         st = state if state is not None else self.state
-        x, y = remove_duplicates(st.parents_x, st.parents_y)
+        x, y = remove_duplicates(np.asarray(st.parents_x), np.asarray(st.parents_y))
         if len(x) > 0:
             xs, ys, _, _, _ = sort_mo(
                 jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32)

@@ -1,77 +1,99 @@
-"""Shared front-fill + EHVI mid-front survival selection.
+"""On-device front-fill survival selection with hypervolume-contribution
+mid-front breaking.
 
 Both MO-CMA-ES and TRS fill the next population front-by-front and break
-the first front that does not fit with expected-hypervolume-improvement
-scores (reference: dmosopt/CMAES.py:167-230 and dmosopt/TRS.py:199-266 —
-the logic is duplicated verbatim in the reference; here it is one
-function). EHVI scoring runs on device (dmosopt_tpu.hv.ehvi_batch).
+the first front that does not fit with a hypervolume-improvement score
+(reference: dmosopt/CMAES.py:167-230 and dmosopt/TRS.py:199-266 — the
+logic is duplicated verbatim in the reference; here it is one function).
+
+TPU redesign: the reference's selection is a host loop over fronts plus
+an exact-EHVI box decomposition evaluated with *unit* predictive
+variances (CMAES.py:204-212 passes ``np.ones_like``) — i.e. a smooth
+scoring heuristic, not a true posterior EHVI. Here the whole selection is
+one jitted masked program with static shapes, scannable inside the
+generation loop:
+
+- non-dominated rank (one (N,N,d) reduction, already on device),
+- per-front sizes/offsets via segment-sum + cumsum,
+- fronts that fit entirely are taken; the first front that overflows is
+  broken by a Monte-Carlo hypervolume-contribution score (volume
+  dominated by the candidate but by none of the already-taken points),
+  computed in sample blocks under `lax.scan`,
+- the final pick is a single stable argsort on (rank, -score).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from functools import partial
+from typing import Tuple
 
-import numpy as np
+import jax
 import jax.numpy as jnp
 
-from dmosopt_tpu.indicators import HypervolumeImprovement
 from dmosopt_tpu.ops import non_dominated_rank
 
 
-def ehvi_front_selection(
-    candidates_y: np.ndarray,
+@partial(jax.jit, static_argnames=("n_samples",))
+def hv_contribution_scores(
+    key: jax.Array,
+    y: jax.Array,
+    attained_mask: jax.Array,
+    n_samples: int = 4096,
+) -> jax.Array:
+    """MC estimate of each candidate's exclusive dominated volume
+    (minimization): the fraction of uniform samples in the [ideal,
+    nadir+1] box dominated by candidate i but by no point in
+    ``attained_mask``. Sampled in fixed blocks under scan so memory is
+    bounded at any population size."""
+    n, d = y.shape
+    ref = jnp.max(y, axis=0) + 1.0
+    lo = jnp.min(y, axis=0)
+    block = 512
+    n_blocks = max(1, (n_samples + block - 1) // block)
+
+    def body(carry, k):
+        s = lo + jax.random.uniform(k, (block, d), y.dtype) * (ref - lo)
+        dom = jnp.all(y[None, :, :] <= s[:, None, :], axis=2)  # (block, n)
+        dom_att = jnp.any(dom & attained_mask[None, :], axis=1)  # (block,)
+        return carry + jnp.sum(dom & ~dom_att[:, None], axis=0), None
+
+    counts, _ = jax.lax.scan(
+        body, jnp.zeros((n,), jnp.float32), jax.random.split(key, n_blocks)
+    )
+    return counts / (n_blocks * block)
+
+
+@partial(jax.jit, static_argnames=("popsize", "n_samples"))
+def front_fill_selection(
+    key: jax.Array,
+    candidates_y: jax.Array,
     popsize: int,
-    indicator_cls=HypervolumeImprovement,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Select exactly `popsize` of the candidates (when more are offered).
+    n_samples: int = 4096,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Select exactly ``popsize`` of ``candidates_y`` (N > popsize, static).
 
-    Returns (chosen, not_chosen, rank): boolean masks over candidates and
-    the non-dominated rank of every candidate.
+    Returns (sel_idx, chosen, rank): ``sel_idx`` (popsize,) gather indices
+    ordered by (rank, -score), ``chosen`` (N,) boolean mask, ``rank`` (N,)
+    non-dominated rank of every candidate.
     """
-    n_cand = candidates_y.shape[0]
-    rank = np.asarray(non_dominated_rank(jnp.asarray(candidates_y, jnp.float32)))
-    if n_cand <= popsize:
-        return (
-            np.ones(n_cand, dtype=bool),
-            np.zeros(n_cand, dtype=bool),
-            rank,
-        )
+    y = candidates_y.astype(jnp.float32)
+    n = y.shape[0]
+    rank = non_dominated_rank(y)
 
-    chosen = np.zeros(n_cand, dtype=bool)
-    not_chosen = np.zeros(n_cand, dtype=bool)
-    mid_front: Optional[np.ndarray] = None
-    chosen_count = 0
-    full = False
-    for r in range(int(rank.max()) + 1):
-        front_r = np.flatnonzero(rank == r)
-        if chosen_count + len(front_r) <= popsize and not full:
-            chosen[front_r] = True
-            chosen_count += len(front_r)
-        elif mid_front is None and chosen_count < popsize:
-            mid_front = front_r.copy()
-            full = True
-        else:
-            not_chosen[front_r] = True
+    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), rank, num_segments=n)
+    starts = jnp.cumsum(sizes) - sizes
+    front_start = starts[rank]
+    front_end = front_start + sizes[rank]
 
-    k = popsize - chosen_count
-    if k > 0:
-        assert mid_front is not None and len(mid_front) > 0
-        # reference point: the worst candidate in each dimension + 1
-        ref = np.max(candidates_y, axis=0) + 1
-        if chosen_count > 0:
-            indicator = indicator_cls(ref_point=ref, nds=True)
-            selected = indicator.do(
-                candidates_y[chosen],
-                candidates_y[mid_front, :],
-                np.ones_like(candidates_y[mid_front, :]),
-                k,
-            )
-        else:
-            selected = np.arange(k)
-        chosen[mid_front[selected]] = True
-        rest = np.ones(len(mid_front), dtype=bool)
-        rest[selected] = False
-        not_chosen[mid_front[rest]] = True
-    elif mid_front is not None:
-        not_chosen[mid_front] = True
-    return chosen, not_chosen, rank
+    fully_chosen = front_end <= popsize  # whole front fits
+    in_mid = (front_start < popsize) & ~fully_chosen
+
+    scores = hv_contribution_scores(key, y, fully_chosen, n_samples=n_samples)
+    scores = jnp.where(in_mid, scores, 0.0)
+    # tie-break stays strictly inside one rank unit
+    scores = scores / (jnp.max(scores) + 1e-9) * 0.999
+
+    order = jnp.argsort(rank.astype(jnp.float32) - scores, stable=True)
+    sel_idx = order[:popsize]
+    chosen = jnp.zeros((n,), bool).at[sel_idx].set(True)
+    return sel_idx, chosen, rank

@@ -1,60 +1,59 @@
-"""TRS: trust-region search, multi-objective local optimization.
+"""TRS: trust-region search, multi-objective local optimization, TPU-native.
 
 Algorithm semantics follow the reference (dmosopt/TRS.py:19-322):
-per-center trust boxes of width `tr.length` scaled by normalized bound
+per-center trust boxes of width `tr_length` scaled by normalized bound
 weights; Sobol perturbations applied through a `min(20/dim, 1)`
 perturbation mask (Regis & Shoemaker 2013); survival by front fill with
-EHVI mid-front breaking; a success sliding window drives trust-region
-expand/shrink/restart.
+hypervolume mid-front breaking; a success sliding window drives
+trust-region expand/shrink/restart.
 
-Like MO-CMA-ES, survival selection is data-dependent host logic
-(`jit_compatible = False`); the EHVI scores and dominance ranks run on
-device.
+TPU redesign: everything runs as pure functions over a fixed-shape state
+pytree so the generation loop scans (``jit_compatible = True``; the
+reference loops per generation on the host):
+
+- survival selection is the masked on-device front fill of
+  `ehvi_select.front_fill_selection`;
+- the Sobol perturbations come from the in-graph generator
+  (`sampling.sobol_block`: direction numbers are a state constant, a
+  fresh random digital shift per generation replaces re-scrambling);
+- the success SlidingWindow becomes a fixed ring buffer in the state;
+  trust-region expand/shrink/restart are `jnp.where`/`lax.cond` updates
+  on scalars (reference TRS.py:268-292);
+- the reference dedupes centers and pads with global Sobol samples
+  (TRS.py:144-147); with static shapes every center (duplicate or not)
+  emits one candidate — duplicates merely repeat a box.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
-from dmosopt_tpu.indicators import (
-    HypervolumeImprovement,
-    PopulationDiversity,
-    SlidingWindow,
-)
-from dmosopt_tpu.moasmo import remove_duplicates
-from dmosopt_tpu.optimizers.base import MOEA, Struct
-from dmosopt_tpu.optimizers.ehvi_select import ehvi_front_selection
-from dmosopt_tpu.ops import order_mo
-from dmosopt_tpu.sampling import sobol
-from dmosopt_tpu.utils.prng import as_generator
+from dmosopt_tpu.optimizers.base import MOEA
+from dmosopt_tpu.optimizers.ehvi_select import front_fill_selection
+from dmosopt_tpu.ops import non_dominated_rank
+from dmosopt_tpu.sampling import sobol_block, sobol_direction_numbers
 
 
-@dataclass
-class TrState:
-    """Trust-region state (reference dmosopt/TRS.py:19-37)."""
-
-    dim: int
-    is_constrained: bool = False
-    length: float = 0.05
-    length_init: float = 0.1
-    length_min: float = 0.00001
-    length_max: float = 1.0
-    failure_tolerance: float = float("nan")
-    success_tolerance: float = 0.51
-    Y_best: np.ndarray = field(default_factory=lambda: np.asarray([np.inf]))
-    restart: bool = False
-
-    def __post_init__(self):
-        self.failure_tolerance = min(1 / self.dim, self.success_tolerance / 2.0)
-        self.Y_best = np.asarray([np.inf] * self.dim).reshape((1, -1))
+class TRSState(NamedTuple):
+    bounds: jax.Array  # (n, 2)
+    population_parm: jax.Array  # (P, n)
+    population_obj: jax.Array  # (P, d)
+    rank: jax.Array  # (P,)
+    tr_length: jax.Array  # () trust-region width
+    restart: jax.Array  # () bool — shrink bottomed out; reset next update
+    succ_buffer: jax.Array  # (W,) success-count ring buffer
+    succ_count: jax.Array  # () entries appended (capped at W)
+    succ_ptr: jax.Array  # () ring write position
+    sobol_sv: jax.Array  # (n, 30) uint32 direction numbers
+    sel_key: jax.Array  # PRNG key for selection MC scoring
 
 
 class TRS(MOEA):
-    jit_compatible = False
+    jit_compatible = True
 
     def __init__(
         self,
@@ -70,137 +69,139 @@ class TRS(MOEA):
             name="TRS", popsize=popsize, nInput=nInput, nOutput=nOutput, **kwargs
         )
         self.model = model
-        self.x_distance_metrics = None
-        feasibility = getattr(model, "feasibility", None) if model is not None else None
-        if feasibility is not None:
-            self.x_distance_metrics = [feasibility.rank]
-        self.indicator = HypervolumeImprovement
-        self.diversity_indicator = PopulationDiversity()
         self.optimize_mean_variance = optimize_mean_variance
 
     @property
     def default_parameters(self) -> Dict[str, Any]:
-        # Reference defaults: dmosopt/TRS.py:68-77.
+        # Reference defaults: dmosopt/TRS.py:19-37,68-77.
         return {
             "nchildren": 1,
             "success_window_size": 64,
+            "length_init": 0.1,
+            "length_start": 0.05,
+            "length_min": 0.00001,
+            "length_max": 1.0,
+            "success_tolerance": 0.51,
+            "selection_mc_samples": 4096,
             "max_population_size": 600,
             "min_population_size": 100,
             "adaptive_population_size": False,
         }
 
-    # ----------------------------------------------------------- host API
+    @property
+    def failure_tolerance(self) -> float:
+        # reference TrState.__post_init__ (TRS.py:51-53)
+        return min(1.0 / self.nInput, self.opt_params.success_tolerance / 2.0)
 
-    def initialize_strategy(self, x, y, bounds, random=None, **params):
-        self.bounds = np.asarray(bounds, dtype=np.float32)
-        self.local_random = as_generator(random)
-        x = np.asarray(x, np.float32)
-        y = np.asarray(y, np.float32)
-        perm, rank, _ = order_mo(
-            jnp.asarray(x), jnp.asarray(y),
-            x_distance_metrics=self.x_distance_metrics,
-        )
-        perm = np.asarray(perm)
-        rank = np.asarray(rank)
+    # ----------------------------------------------------- pure functions
+
+    def initialize_state(self, key, x, y, bounds) -> TRSState:
         P = self.popsize
-        self.state = Struct(
-            bounds=self.bounds,
-            population_parm=x[perm][:P],
-            population_obj=y[perm][:P],
-            rank=rank[:P],
-            tr=TrState(dim=self.nInput),
-            success_window=SlidingWindow(self.opt_params.success_window_size),
+        W = self.opt_params.success_window_size
+        rank = non_dominated_rank(y)
+        order = jnp.argsort(rank, stable=True)
+        idx = order[jnp.arange(P) % x.shape[0]]
+        return TRSState(
+            bounds=bounds,
+            population_parm=x[idx],
+            population_obj=y[idx],
+            rank=rank[idx],
+            tr_length=jnp.asarray(self.opt_params.length_start, jnp.float32),
+            restart=jnp.zeros((), bool),
+            succ_buffer=jnp.zeros((W,), jnp.float32),
+            succ_count=jnp.zeros((), jnp.int32),
+            succ_ptr=jnp.zeros((), jnp.int32),
+            sobol_sv=jnp.asarray(sobol_direction_numbers(self.nInput)),
+            sel_key=key,
         )
-        return self.state
 
-    def generate(self, **params):
+    def generate_strategy(self, key, state: TRSState):
         P = self.popsize
-        rng = self.local_random
-        xlb, xub = self.bounds[:, 0], self.bounds[:, 1]
-        st = self.state
-
-        population_parm, population_obj = remove_duplicates(
-            st.population_parm, st.population_obj
-        )
+        n = self.nInput
+        xlb, xub = state.bounds[:, 0], state.bounds[:, 1]
+        k_shift, k_mask = jax.random.split(key)
 
         # trust-region boxes around each center (reference TRS.py:118-126)
-        x_centers = population_parm
         weights = xub - xlb
-        weights = weights / np.mean(weights)
-        weights = weights / np.prod(np.power(weights, 1.0 / len(weights)))
-        tr_lb = np.clip(x_centers - weights * st.tr.length / 2.0, xlb, xub)
-        tr_ub = np.clip(x_centers + weights * st.tr.length / 2.0, xlb, xub)
+        weights = weights / jnp.mean(weights)
+        weights = weights / jnp.prod(
+            jnp.power(weights, 1.0 / weights.shape[0])
+        )
+        centers = state.population_parm
+        tr_lb = jnp.clip(centers - weights * state.tr_length / 2.0, xlb, xub)
+        tr_ub = jnp.clip(centers + weights * state.tr_length / 2.0, xlb, xub)
 
-        pert = sobol(x_centers.shape[0], self.nInput, rng)
-        pert = tr_lb + (tr_ub - tr_lb) * pert
+        pert = tr_lb + (tr_ub - tr_lb) * sobol_block(state.sobol_sv, k_shift, P)
 
         # perturbation mask: fewer dims at a time in high dimension
-        prob_perturb = min(20.0 / st.tr.dim, 1.0)
-        perturb_mask = rng.random((st.tr.dim,)) <= prob_perturb
+        prob_perturb = min(20.0 / n, 1.0)
+        mask = jax.random.bernoulli(k_mask, prob_perturb, (n,))
+        x_cand = jnp.where(mask[None, :], pert, centers)
+        return x_cand, state
 
-        X_cand = x_centers.copy()
-        X_cand[:, perturb_mask] = pert[:, perturb_mask]
+    def update_strategy(self, state: TRSState, x_gen, y_gen) -> TRSState:
+        opt = self.opt_params
+        P = self.popsize
+        C = x_gen.shape[0]
+        W = opt.success_window_size
 
-        if X_cand.shape[0] < P:
-            sample = sobol(P - X_cand.shape[0], self.nInput, rng)
-            X_cand = np.vstack((X_cand, xlb + (xub - xlb) * sample))
-        return X_cand.astype(np.float32), {}
-
-    generate_strategy = None  # host-loop optimizer
-
-    def update(self, x_gen, y_gen, state=None, **params):
-        st = self.state
-        x_gen = np.asarray(x_gen, np.float32)
-        y_gen = np.asarray(y_gen, np.float32)
-        candidates_x = np.vstack((x_gen, st.population_parm))
-        candidates_y = np.vstack((y_gen, st.population_obj))
-        is_offspring = np.concatenate(
-            (
-                np.ones(x_gen.shape[0], dtype=bool),
-                np.zeros(st.population_parm.shape[0], dtype=bool),
+        # a bottomed-out trust region restarts at the top of the next
+        # update (reference TRS.py:164-166, 192-199)
+        def do_restart(s: TRSState) -> TRSState:
+            return s._replace(
+                tr_length=jnp.asarray(opt.length_init, jnp.float32),
+                restart=jnp.zeros((), bool),
+                succ_buffer=jnp.zeros((W,), jnp.float32),
+                succ_count=jnp.zeros((), jnp.int32),
+                succ_ptr=jnp.zeros((), jnp.int32),
             )
-        )
 
-        tr = st.tr
-        if tr.restart:
-            self._restart_state()
+        state = jax.lax.cond(state.restart, do_restart, lambda s: s, state)
 
-        chosen, not_chosen, rank = ehvi_front_selection(
-            candidates_y, self.popsize, self.indicator
+        cand_y = jnp.concatenate([y_gen, state.population_obj], axis=0)
+        sel_key, k = jax.random.split(state.sel_key)
+        sel_idx, chosen, rank = front_fill_selection(
+            k, cand_y, P, n_samples=opt.selection_mc_samples
         )
 
         # success-window trust-region control (reference TRS.py:268-292)
-        success_counter = int(np.count_nonzero(is_offspring & chosen))
-        st.success_window.append(success_counter)
-        success_mean = float(np.mean(st.success_window[:]))
-        success_frac = min(1.0, success_mean / self.popsize)
-        if success_frac > tr.success_tolerance:
-            tr.length = min(
-                (1.0 + (success_frac - tr.success_tolerance)) * tr.length,
-                tr.length_max,
-            )
-        elif success_frac <= tr.failure_tolerance:
-            tr.length /= 2.0
-        if tr.length < tr.length_min:
-            tr.restart = True
+        succ = jnp.sum(chosen[:C].astype(jnp.float32))
+        buffer = state.succ_buffer.at[state.succ_ptr].set(succ)
+        ptr = (state.succ_ptr + 1) % W
+        count = jnp.minimum(state.succ_count + 1, W)
+        success_mean = jnp.sum(buffer) / jnp.maximum(count, 1).astype(
+            jnp.float32
+        )
+        success_frac = jnp.minimum(1.0, success_mean / P)
 
-        st.population_parm = candidates_x[chosen]
-        st.population_obj = candidates_y[chosen]
-        st.rank = rank[chosen]
-        return st
+        grow = success_frac > opt.success_tolerance
+        shrink = success_frac <= self.failure_tolerance
+        length = jnp.where(
+            grow,
+            jnp.minimum(
+                (1.0 + (success_frac - opt.success_tolerance)) * state.tr_length,
+                opt.length_max,
+            ),
+            jnp.where(shrink, state.tr_length / 2.0, state.tr_length),
+        )
+        restart = length < opt.length_min
 
-    def _restart_state(self):
-        tr = self.state.tr
-        tr.length = tr.length_init
-        tr.Y_best = np.asarray([np.inf] * tr.dim).reshape((1, -1))
-        tr.restart = False
-        self.state.success_window = SlidingWindow(
-            self.opt_params.success_window_size
+        cand_x = jnp.concatenate([x_gen, state.population_parm], axis=0)
+        return state._replace(
+            population_parm=cand_x[sel_idx],
+            population_obj=cand_y[sel_idx],
+            rank=rank[sel_idx],
+            tr_length=length,
+            restart=restart,
+            succ_buffer=buffer,
+            succ_count=count,
+            succ_ptr=ptr,
+            sel_key=sel_key,
         )
 
     def get_population_strategy(self, state=None):
         st = state if state is not None else self.state
-        return st.population_parm.copy(), st.population_obj.copy()
+        return np.asarray(st.population_parm), np.asarray(st.population_obj)
 
     @property
     def population_objectives(self):

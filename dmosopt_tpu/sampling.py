@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 import math
+from functools import partial
 
 import numpy as np
 import jax
@@ -72,6 +73,78 @@ def SobolDesign(n: int, s: int, random=None) -> np.ndarray:
     m = max(1, math.ceil(math.log2(max(n, 2))))
     sample = sampler.random_base2(m)
     return np.asarray(sample[:n])
+
+
+# --------------------------------------------------------- on-device Sobol
+
+SOBOL_BITS = 30  # scipy 1.17's direction numbers are 30-bit fractions
+
+
+def sobol_direction_numbers(dim: int) -> np.ndarray:
+    """Joe-Kuo direction numbers for a `dim`-dimensional Sobol sequence,
+    (dim, bits) uint32, extracted host-side once so point generation can
+    run in-graph (`sobol_block`, which reads the bit width off the table
+    shape)."""
+    from scipy.stats import qmc
+
+    sampler = qmc.Sobol(d=dim, scramble=False)
+    sv = getattr(sampler, "_sv", None)  # private scipy internals
+    if sv is None or np.ndim(sv) != 2 or np.shape(sv)[0] != dim:
+        raise RuntimeError(
+            "cannot extract Sobol direction numbers from scipy.stats.qmc."
+            "Sobol._sv (scipy internals changed?); pin scipy or supply a "
+            "direction-number table to sobol_block directly"
+        )
+    return np.asarray(sv, dtype=np.uint32)
+
+
+def _xor_reduce(x, axis):
+    """XOR-reduce a uint32 array along `axis` by halving (static width)."""
+    x = jnp.moveaxis(x, axis, -1)
+    width = x.shape[-1]
+    # pad to a power of two with zeros (XOR identity)
+    p = 1
+    while p < width:
+        p *= 2
+    if p != width:
+        pad = [(0, 0)] * (x.ndim - 1) + [(0, p - width)]
+        x = jnp.pad(x, pad)
+    while x.shape[-1] > 1:
+        h = x.shape[-1] // 2
+        x = jnp.bitwise_xor(x[..., :h], x[..., h:])
+    return x[..., 0]
+
+
+@partial(jax.jit, static_argnames=("n",))
+def sobol_block(sv: jax.Array, shift_key: jax.Array, n: int):
+    """First `n` Sobol points with a random digital shift, fully on device.
+
+    `sv` is the (dim, SOBOL_BITS) uint32 direction-number table from
+    `sobol_direction_numbers`. Point k is the XOR of the direction numbers
+    selected by the set bits of gray(k) = k ^ (k >> 1); the per-dimension
+    random shift (drawn from `shift_key`) is XORed in — a randomized-QMC
+    digital shift standing in for the reference's Owen scrambling
+    (dmosopt/sampling.py:11-22), trace-compatible so samplers can run
+    inside `lax.scan` loops (TRS trust-region perturbations).
+    Returns (n, dim) float32 in [0, 1)."""
+    dim, bits = sv.shape
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    gray = idx ^ (idx >> 1)
+    bit = (gray[:, None] >> jnp.arange(bits, dtype=jnp.uint32)[None, :]) & 1
+    # (n, dim, bits): direction number where the gray bit is set, else 0
+    contrib = jnp.where(
+        bit[:, None, :].astype(bool), sv[None, :, :], jnp.uint32(0)
+    )
+    x = _xor_reduce(contrib, axis=2)  # (n, dim)
+    shift = jax.random.bits(shift_key, (dim,), jnp.uint32) >> jnp.uint32(32 - bits)
+    x = x ^ shift[None, :]
+    # truncate to float32's 24-bit mantissa BEFORE the cast: a direct cast
+    # of values near 2^bits rounds up and yields exactly 1.0, violating
+    # the half-open range
+    if bits > 24:
+        x = x >> jnp.uint32(bits - 24)
+        bits = 24
+    return x.astype(jnp.float32) * jnp.float32(2.0**-bits)
 
 
 # ------------------------------------------------------------------- GLP

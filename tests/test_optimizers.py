@@ -58,17 +58,17 @@ def test_smpso_improves_and_is_scannable():
     assert d1 < d0 * 0.5, (d0, d1)
 
 
-def test_cmaes_improves():
+def test_cmaes_improves_and_is_scannable():
     x0, y0 = _init(POP)
     opt = CMAES(popsize=POP, nInput=DIM, nOutput=2, model=None)
     opt.initialize_strategy(x0, y0, BOUNDS, random=2)
     d0 = _mean_dist(opt.state.parents_y)
-    _, y = _host_loop(opt, 40)
-    d1 = _mean_dist(y)
+    st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(5), 40, zdt1)
+    d1 = _mean_dist(st.parents_y)
     assert d1 < d0, (d0, d1)
-    assert opt.state.parents_x.shape == (POP, DIM)
+    assert st.parents_x.shape == (POP, DIM)
     # sigma adaptation happened
-    assert not np.allclose(opt.state.sigmas, opt.state.sigmas[0, 0])
+    assert not np.allclose(np.asarray(st.sigmas), np.asarray(st.sigmas)[0, 0])
 
 
 def test_trs_improves_and_adapts_region():
@@ -76,12 +76,26 @@ def test_trs_improves_and_adapts_region():
     opt = TRS(popsize=POP, nInput=DIM, nOutput=2, model=None)
     opt.initialize_strategy(x0, y0, BOUNDS, random=3)
     d0 = _mean_dist(opt.state.population_obj)
-    _, y = _host_loop(opt, 40)
-    d1 = _mean_dist(y)
+    st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(6), 40, zdt1)
+    d1 = _mean_dist(st.population_obj)
     assert d1 < d0, (d0, d1)
     # success window drives the trust region; length stays in bounds
-    assert len(opt.state.success_window) == 40
-    assert opt.state.tr.length_min <= opt.state.tr.length <= opt.state.tr.length_max
+    assert int(st.succ_count) == 40
+    assert (
+        opt.opt_params.length_min
+        <= float(st.tr_length)
+        <= opt.opt_params.length_max
+    )
+
+
+def test_cmaes_host_api_matches_scan_contract():
+    """The stateful host API (generate/update) still drives CMAES — the
+    pure functions back both paths."""
+    x0, y0 = _init(POP)
+    opt = CMAES(popsize=POP, nInput=DIM, nOutput=2, model=None)
+    opt.initialize_strategy(x0, y0, BOUNDS, random=2)
+    _, y = _host_loop(opt, 5)
+    assert np.all(np.isfinite(y))
 
 
 def test_moasmo_epoch_with_each_optimizer():
