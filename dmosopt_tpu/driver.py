@@ -183,7 +183,9 @@ class DistOptimizer:
             (B, n) flat parameter arrays; evaluation runs as one jitted,
             mesh-sharded call.
           evaluator: externally constructed evaluation backend.
-          mesh: `jax.sharding.Mesh` for sharded batch evaluation.
+          mesh: `jax.sharding.Mesh`; shards the inner EA loop (population
+            axis over the mesh's first axis, SPMD with XLA collectives)
+            and, with jax_objective, the batch evaluation.
           n_eval_workers: thread-pool width for host objectives.
         """
         if (random_seed is not None) and (local_random is not None):
@@ -229,6 +231,7 @@ class DistOptimizer:
         self.local_random = local_random
         self.random_seed = random_seed
         self.time_limit = time_limit
+        self.mesh = mesh
         self.start_time = time.time()
 
         self.logger = logging.getLogger(opt_id)
@@ -506,6 +509,7 @@ class DistOptimizer:
                 local_random=self.local_random,
                 logger=self.logger,
                 file_path=self.file_path,
+                mesh=self.mesh,
             )
             self.storage_dict[problem_id] = []
         if initial is not None:

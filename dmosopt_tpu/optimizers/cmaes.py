@@ -14,7 +14,8 @@ Python loop with per-individual updates, CMAES.py:345-397):
 
 - survival selection is the masked on-device front fill of
   `ehvi_select.front_fill_selection` (the reference's host loop over
-  fronts + exact EHVI with unit variances);
+  fronts + exact EHVI with unit variances, whose diversity role the
+  in-front crowding tie-break takes over);
 - the per-parent success/failure bookkeeping — the reference applies
   psucc/sigma updates sequentially, all successes then all failures —
   is replaced by its closed form: with m successes then f failures and
@@ -92,7 +93,6 @@ class CMAESState(NamedTuple):
     psucc: jax.Array  # (P,)
     rank: jax.Array  # (P,)
     gen_pidx: jax.Array  # (C,) parent index of each offspring this gen
-    sel_key: jax.Array  # PRNG key for selection MC scoring
 
 
 class CMAES(MOEA):
@@ -134,7 +134,6 @@ class CMAES(MOEA):
             "ccov": 2.0 / (nInput**2 + 6.0),
             "pthresh": 0.44,
             "di_mutation": 30.0,
-            "selection_mc_samples": 4096,
             "max_population_size": 600,
             "min_population_size": 100,
             "adaptive_population_size": False,
@@ -166,7 +165,6 @@ class CMAES(MOEA):
             psucc=jnp.full((P,), opt.ptarg, jnp.float32),
             rank=rank[idx],
             gen_pidx=jnp.zeros((self.n_offspring,), jnp.int32),
-            sel_key=key,
         )
 
     def generate_strategy(self, key, state: CMAESState):
@@ -195,10 +193,7 @@ class CMAES(MOEA):
         pidx = state.gen_pidx
 
         cand_y = jnp.concatenate([y_gen, state.parents_y], axis=0)
-        sel_key, k = jax.random.split(state.sel_key)
-        sel_idx, chosen, rank = front_fill_selection(
-            k, cand_y, P, n_samples=opt.selection_mc_samples
-        )
+        sel_idx, chosen, rank = front_fill_selection(cand_y, P)
         chosen_off = chosen[:C]
 
         # --- offspring strategy parameters, as if chosen (unchosen ones are
@@ -261,7 +256,6 @@ class CMAES(MOEA):
             pc=cand_pc[sel_idx],
             psucc=cand_psucc[sel_idx],
             rank=rank[sel_idx],
-            sel_key=sel_key,
         )
 
     def get_population_strategy(self, state=None):

@@ -1,4 +1,4 @@
-"""On-device front-fill survival selection with hypervolume-contribution
+"""On-device front-fill survival selection with crowding-distance
 mid-front breaking.
 
 Both MO-CMA-ES and TRS fill the next population front-by-front and break
@@ -9,16 +9,22 @@ logic is duplicated verbatim in the reference; here it is one function).
 TPU redesign: the reference's selection is a host loop over fronts plus
 an exact-EHVI box decomposition evaluated with *unit* predictive
 variances (CMAES.py:204-212 passes ``np.ones_like``) — i.e. a smooth
-scoring heuristic, not a true posterior EHVI. Here the whole selection is
-one jitted masked program with static shapes, scannable inside the
+diversity/closeness heuristic, not a true posterior EHVI (and when
+nothing is chosen yet it falls back to "first k", CMAES.py:69-70). The
+box decomposition is inherently sequential host work, and the exclusive
+hypervolume of mid-front members against the already-taken fronts is
+*identically zero* (every front-r point is dominated by a front-(r-1)
+point), so an exclusive-volume score cannot break the mid front either.
+Here the mid front is broken by crowding distance computed within the
+front — the canonical in-front diversity score (same role the reference
+heuristic plays), mask-aware and fully jittable, so the whole selection
+is one fused program with static shapes, scannable inside the
 generation loop:
 
 - non-dominated rank (one (N,N,d) reduction, already on device),
 - per-front sizes/offsets via segment-sum + cumsum,
 - fronts that fit entirely are taken; the first front that overflows is
-  broken by a Monte-Carlo hypervolume-contribution score (volume
-  dominated by the candidate but by none of the already-taken points),
-  computed in sample blocks under `lax.scan`,
+  broken by masked crowding distance,
 - the final pick is a single stable argsort on (rank, -score).
 """
 
@@ -30,51 +36,19 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from dmosopt_tpu.ops import non_dominated_rank
+from dmosopt_tpu.ops import crowding_distance, non_dominated_rank
 
 
-@partial(jax.jit, static_argnames=("n_samples",))
-def hv_contribution_scores(
-    key: jax.Array,
-    y: jax.Array,
-    attained_mask: jax.Array,
-    n_samples: int = 4096,
-) -> jax.Array:
-    """MC estimate of each candidate's exclusive dominated volume
-    (minimization): the fraction of uniform samples in the [ideal,
-    nadir+1] box dominated by candidate i but by no point in
-    ``attained_mask``. Sampled in fixed blocks under scan so memory is
-    bounded at any population size."""
-    n, d = y.shape
-    ref = jnp.max(y, axis=0) + 1.0
-    lo = jnp.min(y, axis=0)
-    block = 512
-    n_blocks = max(1, (n_samples + block - 1) // block)
-
-    def body(carry, k):
-        s = lo + jax.random.uniform(k, (block, d), y.dtype) * (ref - lo)
-        dom = jnp.all(y[None, :, :] <= s[:, None, :], axis=2)  # (block, n)
-        dom_att = jnp.any(dom & attained_mask[None, :], axis=1)  # (block,)
-        return carry + jnp.sum(dom & ~dom_att[:, None], axis=0), None
-
-    counts, _ = jax.lax.scan(
-        body, jnp.zeros((n,), jnp.float32), jax.random.split(key, n_blocks)
-    )
-    return counts / (n_blocks * block)
-
-
-@partial(jax.jit, static_argnames=("popsize", "n_samples"))
+@partial(jax.jit, static_argnames=("popsize",))
 def front_fill_selection(
-    key: jax.Array,
     candidates_y: jax.Array,
     popsize: int,
-    n_samples: int = 4096,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Select exactly ``popsize`` of ``candidates_y`` (N > popsize, static).
 
     Returns (sel_idx, chosen, rank): ``sel_idx`` (popsize,) gather indices
-    ordered by (rank, -score), ``chosen`` (N,) boolean mask, ``rank`` (N,)
-    non-dominated rank of every candidate.
+    ordered by (rank, -crowding), ``chosen`` (N,) boolean mask, ``rank``
+    (N,) non-dominated rank of every candidate.
     """
     y = candidates_y.astype(jnp.float32)
     n = y.shape[0]
@@ -88,8 +62,7 @@ def front_fill_selection(
     fully_chosen = front_end <= popsize  # whole front fits
     in_mid = (front_start < popsize) & ~fully_chosen
 
-    scores = hv_contribution_scores(key, y, fully_chosen, n_samples=n_samples)
-    scores = jnp.where(in_mid, scores, 0.0)
+    scores = crowding_distance(y, mask=in_mid)
     # tie-break stays strictly inside one rank unit
     scores = scores / (jnp.max(scores) + 1e-9) * 0.999
 

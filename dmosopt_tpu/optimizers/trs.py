@@ -48,8 +48,7 @@ class TRSState(NamedTuple):
     succ_buffer: jax.Array  # (W,) success-count ring buffer
     succ_count: jax.Array  # () entries appended (capped at W)
     succ_ptr: jax.Array  # () ring write position
-    sobol_sv: jax.Array  # (n, 30) uint32 direction numbers
-    sel_key: jax.Array  # PRNG key for selection MC scoring
+    sobol_sv: jax.Array  # (n, bits) uint32 direction numbers
 
 
 class TRS(MOEA):
@@ -82,7 +81,6 @@ class TRS(MOEA):
             "length_min": 0.00001,
             "length_max": 1.0,
             "success_tolerance": 0.51,
-            "selection_mc_samples": 4096,
             "max_population_size": 600,
             "min_population_size": 100,
             "adaptive_population_size": False,
@@ -112,7 +110,6 @@ class TRS(MOEA):
             succ_count=jnp.zeros((), jnp.int32),
             succ_ptr=jnp.zeros((), jnp.int32),
             sobol_sv=jnp.asarray(sobol_direction_numbers(self.nInput)),
-            sel_key=key,
         )
 
     def generate_strategy(self, key, state: TRSState):
@@ -159,10 +156,7 @@ class TRS(MOEA):
         state = jax.lax.cond(state.restart, do_restart, lambda s: s, state)
 
         cand_y = jnp.concatenate([y_gen, state.population_obj], axis=0)
-        sel_key, k = jax.random.split(state.sel_key)
-        sel_idx, chosen, rank = front_fill_selection(
-            k, cand_y, P, n_samples=opt.selection_mc_samples
-        )
+        sel_idx, chosen, rank = front_fill_selection(cand_y, P)
 
         # success-window trust-region control (reference TRS.py:268-292)
         succ = jnp.sum(chosen[:C].astype(jnp.float32))
@@ -196,7 +190,6 @@ class TRS(MOEA):
             succ_buffer=buffer,
             succ_count=count,
             succ_ptr=ptr,
-            sel_key=sel_key,
         )
 
     def get_population_strategy(self, state=None):

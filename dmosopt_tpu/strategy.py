@@ -72,10 +72,12 @@ class DistOptStrategy:
         local_random=None,
         logger=None,
         file_path=None,
+        mesh=None,
     ):
         self.local_random = local_random
         self.logger = logger
         self.file_path = file_path
+        self.mesh = mesh
         self.feasibility_method_name = feasibility_method_name
         self.feasibility_method_kwargs = feasibility_method_kwargs or {}
         self.surrogate_method_name = surrogate_method_name
@@ -356,6 +358,7 @@ class DistOptStrategy:
             local_random=self.local_random,
             logger=self.logger,
             file_path=self.file_path,
+            mesh=self.mesh,
         )
 
         item = None
