@@ -2,47 +2,128 @@
 
 Capability match: reference `dmosopt/adaptive_termination.py` —
 `PerObjectiveConvergence` (:48), `MultiScaleStagnationTermination`
-(:158, timescales [5,10,20,40]), `AdaptiveWindowTermination` (:278),
-`CompositeAdaptiveTermination` (:365), `ResourceAwareTermination`
-(:461), and the `create_adaptive_termination` factory (:531) with
-strategies comprehensive/fast/conservative/simple. Wired in by
-`DistOptStrategy` when `termination_conditions` is truthy.
+(:158), `AdaptiveWindowTermination` (:278), `CompositeAdaptiveTermination`
+(:365), `ResourceAwareTermination` (:461), and the
+`create_adaptive_termination` factory (:531) with strategies
+comprehensive/fast/conservative/simple. Wired in by `DistOptStrategy`
+when `termination_conditions` is truthy.
+
+Structural redesign (not a port): the reference threads every criterion
+through a _store/_metric/_decide sliding-window protocol holding lists
+of dicts, with one `ConvergenceState` object (a deque + three scalars)
+per objective updated in a Python loop. Here all criteria share one
+`ObjectiveTrace` — a fixed-capacity ring buffer of per-generation
+population statistics stored as dense `(capacity, d)` arrays — and
+every per-objective computation (ideal-point deltas at arbitrary lags,
+stagnation counters, convergence flags) is a vectorized array
+operation over the objective axis. Decision cadence (`nth_gen`) and the
+generation cap are handled uniformly in `_TracedTermination`.
 """
 
 from __future__ import annotations
 
 import time
-from collections import deque
-from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from dmosopt_tpu.hv_termination import HypervolumeProgressTermination
-from dmosopt_tpu.indicators import crowding_distance_metric
 from dmosopt_tpu.termination import (
     MaximumGenerationTermination,
-    SlidingWindowTermination,
     Termination,
     TerminationCollection,
 )
 
 
-@dataclass
-class ConvergenceState:
-    """Per-objective convergence bookkeeping
-    (reference adaptive_termination.py:31-45)."""
+class ObjectiveTrace:
+    """Ring-buffer history of population statistics, one row per
+    generation observed: ideal point and nadir point. Rows are dense
+    arrays so queries over the objective axis vectorize; lagged lookups
+    are O(1) index arithmetic.
+    """
 
-    values: deque
-    converged: bool = False
-    stagnation_count: int = 0
-    improvement_rate: float = 0.0
+    def __init__(self, capacity: int, n_objectives: int):
+        self.capacity = int(capacity)
+        self.n_seen = 0
+        self._ideal = np.full((self.capacity, n_objectives), np.nan)
+        self._nadir = np.full((self.capacity, n_objectives), np.nan)
+
+    def observe(self, F: np.ndarray) -> None:
+        row = self.n_seen % self.capacity
+        self._ideal[row] = F.min(axis=0)
+        self._nadir[row] = F.max(axis=0)
+        self.n_seen += 1
+
+    def __len__(self) -> int:
+        return min(self.n_seen, self.capacity)
+
+    def _row(self, lag: int) -> int:
+        # lag=0 is the latest observation
+        return (self.n_seen - 1 - lag) % self.capacity
+
+    def ideal(self, lag: int = 0) -> np.ndarray:
+        return self._ideal[self._row(lag)]
+
+    def span(self) -> np.ndarray:
+        """Current nadir-ideal span, floored for safe division."""
+        s = self._nadir[self._row(0)] - self._ideal[self._row(0)]
+        return np.where(s < 1e-32, 1.0, s)
+
+    def ideal_delta(self, lag: int) -> Optional[np.ndarray]:
+        """Per-objective |ideal_now - ideal_lag| normalized by the current
+        span; None until `lag+1` observations exist."""
+        if len(self) < lag + 1:
+            return None
+        return np.abs(self.ideal(0) - self.ideal(lag)) / self.span()
 
 
-class PerObjectiveConvergence(SlidingWindowTermination):
+class _TracedTermination(Termination):
+    """Shared skeleton: feed the trace every call, decide every
+    `nth_gen` generations, stop unconditionally past `n_max_gen`."""
+
+    def __init__(
+        self,
+        problem,
+        capacity: int,
+        nth_gen: int = 1,
+        n_max_gen: Optional[int] = None,
+        **_ignored,
+    ):
+        super().__init__(problem)
+        self.nth_gen = int(nth_gen)
+        self.n_max_gen = np.inf if n_max_gen is None else n_max_gen
+        self.trace = ObjectiveTrace(capacity, problem.n_objectives)
+
+    def _do_continue(self, opt):
+        if opt.n_gen > self.n_max_gen:
+            self._log(
+                f"Optimization terminated: maximum number of generations "
+                f"({opt.n_gen}) has been reached"
+            )
+            return False
+        self.trace.observe(np.asarray(opt.y))
+        self._update()
+        if opt.n_gen % self.nth_gen != 0:
+            return True
+        return self._continue_from_trace()
+
+    def _update(self) -> None:
+        """Per-observation bookkeeping (optional)."""
+
+    def _continue_from_trace(self) -> bool:  # pragma: no cover - abstract
+        return True
+
+
+class PerObjectiveConvergence(_TracedTermination):
     """Track each objective's ideal-point progress independently;
-    terminate when a fraction has converged
-    (reference adaptive_termination.py:48-155)."""
+    terminate when a fraction has converged.
+
+    Same criterion as reference adaptive_termination.py:48-155, with the
+    per-objective deque-of-deltas bookkeeping replaced by a single
+    `(n_last, d)` delta ring and integer/bool arrays over the objective
+    axis: an objective converges after `patience` consecutive checks
+    whose windowed mean delta is below `obj_tol`.
+    """
 
     def __init__(
         self,
@@ -52,151 +133,103 @@ class PerObjectiveConvergence(SlidingWindowTermination):
         n_last: int = 20,
         nth_gen: int = 5,
         n_max_gen: Optional[int] = None,
+        patience: int = 3,
         **kwargs,
     ):
         super().__init__(
-            problem,
-            metric_window_size=n_last,
-            data_window_size=2,
-            min_data_for_metric=2,
-            nth_gen=nth_gen,
-            n_max_gen=n_max_gen,
-            **kwargs,
+            problem, capacity=n_last + 1, nth_gen=nth_gen, n_max_gen=n_max_gen
         )
-        self.n_objectives = problem.n_objectives
+        d = problem.n_objectives
         self.obj_tol = obj_tol
         self.min_converged_fraction = min_converged_fraction
-        self.objective_states = [
-            ConvergenceState(values=deque(maxlen=n_last))
-            for _ in range(self.n_objectives)
-        ]
+        self.n_last = int(n_last)
+        self.patience = int(patience)
+        self._deltas = np.full((self.n_last, d), np.nan)
+        self._n_deltas = 0
+        self.stagnation = np.zeros(d, dtype=int)
+        self.converged = np.zeros(d, dtype=bool)
 
-    def _store(self, opt):
-        F = np.asarray(opt.y)
-        return {"ideal": F.min(axis=0), "nadir": F.max(axis=0), "F": F}
+    def _update(self):
+        delta = self.trace.ideal_delta(1)
+        if delta is None:
+            return
+        self._deltas[self._n_deltas % self.n_last] = delta
+        self._n_deltas += 1
+        if self._n_deltas < self.n_last:
+            return
+        mean_change = self._deltas.mean(axis=0)  # (d,)
+        self.improvement_rate = mean_change
+        below = mean_change < self.obj_tol
+        self.stagnation = np.where(below, self.stagnation + 1, 0)
+        self.converged = self.stagnation >= self.patience
 
-    def _metric(self, data):
-        last, current = data[-2], data[-1]
-        norm = current["nadir"] - current["ideal"]
-        norm = np.where(norm < 1e-32, 1.0, norm)
-        delta_ideal = np.abs(current["ideal"] - last["ideal"]) / norm
-
-        for i, delta in enumerate(delta_ideal):
-            st = self.objective_states[i]
-            st.values.append(delta)
-            if len(st.values) >= self.metric_window_size:
-                mean_change = float(np.mean(st.values))
-                st.improvement_rate = mean_change
-                if mean_change < self.obj_tol:
-                    st.stagnation_count += 1
-                    if st.stagnation_count >= 3:
-                        st.converged = True
-                else:
-                    st.stagnation_count = 0
-                    st.converged = False
-
-        return {
-            "delta_ideal": delta_ideal,
-            "converged_objectives": sum(s.converged for s in self.objective_states),
-            "mean_improvement": float(
-                np.mean([s.improvement_rate for s in self.objective_states])
-            ),
-        }
-
-    def _decide(self, metrics):
-        latest = metrics[-1]
-        n_converged = latest["converged_objectives"]
-        converged_fraction = n_converged / self.n_objectives
-        if converged_fraction >= self.min_converged_fraction:
+    def _continue_from_trace(self):
+        d = self.converged.size
+        n_conv = int(self.converged.sum())
+        if n_conv / d >= self.min_converged_fraction:
             self._log(
-                f"Optimization terminated: {n_converged}/{self.n_objectives} "
-                f"objectives ({converged_fraction:.1%}) have converged"
+                f"Optimization terminated: {n_conv}/{d} objectives "
+                f"({n_conv / d:.1%}) have converged"
             )
             return False
         return True
 
 
-class MultiScaleStagnationTermination(SlidingWindowTermination):
-    """Stagnation detection at multiple timescales simultaneously
-    (reference adaptive_termination.py:158-275)."""
+class MultiScaleStagnationTermination(_TracedTermination):
+    """Stagnation must show simultaneously at several timescales before
+    stopping (same criterion as reference adaptive_termination.py:158-275:
+    mean normalized ideal-point change over lags [5,10,20,40] by default).
+    One trace query per scale; no per-scale history objects."""
 
     def __init__(
         self,
         problem,
-        timescales: List[int] = (5, 10, 20, 40),
+        timescales: Sequence[int] = (5, 10, 20, 40),
         stagnation_tol: float = 1e-4,
         min_scales_stagnant: int = 3,
         n_max_gen: Optional[int] = None,
         nth_gen: int = 1,
         **kwargs,
     ):
-        timescales = list(timescales)
-        max_scale = max(timescales)
+        self.timescales = sorted(int(s) for s in timescales)
         super().__init__(
             problem,
-            metric_window_size=max_scale,
-            data_window_size=max_scale,
-            min_data_for_metric=max_scale,
+            capacity=max(self.timescales) + 1,
             nth_gen=nth_gen,
             n_max_gen=n_max_gen,
-            **kwargs,
         )
-        self.timescales = sorted(timescales)
         self.stagnation_tol = stagnation_tol
-        self.min_scales_stagnant = min_scales_stagnant
+        self.min_scales_stagnant = int(min_scales_stagnant)
 
-    def _store(self, opt):
-        F = np.asarray(opt.y)
-        cd = crowding_distance_metric(F)
-        finite = cd[np.isfinite(cd)]
-        diversity = float(np.mean(finite)) if len(finite) else 0.0
-        return {
-            "ideal": F.min(axis=0),
-            "nadir": F.max(axis=0),
-            "diversity": diversity,
-            "F": F,
-            "X": np.asarray(opt.x),
-        }
-
-    def _metric(self, data):
-        if len(data) < 2:
-            return None
-        current = data[-1]
-        scale_improvements = {}
+    def stagnant_scales(self) -> List[int]:
+        out = []
         for scale in self.timescales:
-            if len(data) >= scale + 1:
-                past = data[-(scale + 1)]
-                norm = current["nadir"] - current["ideal"]
-                norm = np.where(norm < 1e-32, 1.0, norm)
-                delta_ideal = np.abs(current["ideal"] - past["ideal"]) / norm
-                mean_delta = float(np.mean(delta_ideal))
-                scale_improvements[scale] = {
-                    "ideal_change": mean_delta,
-                    "diversity_change": abs(
-                        current["diversity"] - past["diversity"]
-                    ),
-                    "stagnant": mean_delta < self.stagnation_tol,
-                }
-        return scale_improvements
+            delta = self.trace.ideal_delta(scale)
+            if delta is not None and float(delta.mean()) < self.stagnation_tol:
+                out.append(scale)
+        return out
 
-    def _decide(self, metrics):
-        latest = metrics[-1]
-        if not latest:
+    def _continue_from_trace(self):
+        # no decision until the longest horizon has actually been measured
+        # (the reference's min_data_for_metric=max(timescales) gate)
+        if len(self.trace) < max(self.timescales) + 1:
             return True
-        stagnant_scales = [s for s, info in latest.items() if info["stagnant"]]
-        if len(stagnant_scales) >= self.min_scales_stagnant:
+        stagnant = self.stagnant_scales()
+        if len(stagnant) >= self.min_scales_stagnant:
             self._log(
-                f"Optimization terminated: {len(stagnant_scales)}/"
+                f"Optimization terminated: {len(stagnant)}/"
                 f"{len(self.timescales)} timescales show stagnation "
-                f"(scales: {stagnant_scales})"
+                f"(scales: {stagnant})"
             )
             return False
         return True
 
 
-class AdaptiveWindowTermination(SlidingWindowTermination):
-    """Window size grows while progress is detected
-    (reference adaptive_termination.py:278-362)."""
+class AdaptiveWindowTermination(_TracedTermination):
+    """Mean ideal-point delta over a window whose size grows while the
+    optimizer is still making progress (same criterion as reference
+    adaptive_termination.py:278-362). The delta history lives in one
+    ring sized for the maximum window, so growth never reallocates."""
 
     def __init__(
         self,
@@ -208,55 +241,46 @@ class AdaptiveWindowTermination(SlidingWindowTermination):
         n_max_gen: Optional[int] = None,
         **kwargs,
     ):
-        super().__init__(
-            problem,
-            metric_window_size=initial_window,
-            data_window_size=2,
-            min_data_for_metric=2,
-            nth_gen=1,
-            n_max_gen=n_max_gen,
-            **kwargs,
-        )
-        self.initial_window = initial_window
-        self.max_window = max_window
+        super().__init__(problem, capacity=2, nth_gen=1, n_max_gen=n_max_gen)
+        self.window = int(initial_window)
+        self.max_window = int(max_window)
         self.expansion_rate = expansion_rate
         self.tol = tol
-        self.current_window_size = initial_window
+        self._deltas = np.full((self.max_window,), np.nan)
+        self._n_deltas = 0
 
-    def _store(self, opt):
-        F = np.asarray(opt.y)
-        return {"ideal": F.min(axis=0), "nadir": F.max(axis=0)}
+    def _update(self):
+        delta = self.trace.ideal_delta(1)
+        if delta is not None:
+            self._deltas[self._n_deltas % self.max_window] = float(delta.mean())
+            self._n_deltas += 1
 
-    def _metric(self, data):
-        last, current = data[-2], data[-1]
-        norm = current["nadir"] - current["ideal"]
-        norm = np.where(norm < 1e-32, 1.0, norm)
-        delta = float(np.mean(np.abs(current["ideal"] - last["ideal"]) / norm))
-        return {"delta": delta, "window_size": self.current_window_size}
-
-    def _decide(self, metrics):
-        if len(metrics) < self.current_window_size:
+    def _continue_from_trace(self):
+        if self._n_deltas < self.window:
             return True
-        recent = [m["delta"] for m in metrics[-self.current_window_size :]]
-        mean_delta = float(np.mean(recent))
+        take = min(self._n_deltas, self.max_window)
+        recent_rows = (
+            np.arange(self._n_deltas - self.window, self._n_deltas)
+            % self.max_window
+        )
+        mean_delta = float(self._deltas[recent_rows].mean())
         if mean_delta > self.tol * 10:
-            new_window = min(
-                int(self.current_window_size * self.expansion_rate), self.max_window
-            )
-            if new_window > self.current_window_size:
-                self.current_window_size = new_window
-                self.metric_window_size = new_window
+            # still moving: look over a longer horizon before concluding
+            self.window = min(
+                int(self.window * self.expansion_rate), self.max_window, take
+            ) or self.window
         if mean_delta < self.tol:
             self._log(
-                f"Optimization terminated: mean change {mean_delta:.2e} below "
-                f"tolerance over {self.current_window_size} generations"
+                f"Optimization terminated: mean change {mean_delta:.2e} "
+                f"below tolerance over {self.window} generations"
             )
             return False
         return True
 
 
 class CompositeAdaptiveTermination(TerminationCollection):
-    """Bundle of adaptive criteria (reference adaptive_termination.py:365-458)."""
+    """OR-combination of the adaptive criteria plus a generation cap
+    (same membership as reference adaptive_termination.py:365-458)."""
 
     def __init__(
         self,
@@ -266,18 +290,18 @@ class CompositeAdaptiveTermination(TerminationCollection):
         min_converged_fraction: float = 0.8,
         hv_tol: float = 1e-5,
         ref_point: Optional[np.ndarray] = None,
-        timescales: Optional[List[int]] = None,
+        timescales: Optional[Sequence[int]] = None,
         stagnation_tol: float = 1e-4,
         use_per_objective: bool = True,
         use_hypervolume: bool = True,
         use_multiscale: bool = True,
         **kwargs,
     ):
-        terminations = [MaximumGenerationTermination(problem, n_max_gen=n_max_gen)]
+        members: List[Termination] = []
         if use_per_objective:
-            terminations.append(
+            members.append(
                 PerObjectiveConvergence(
-                    problem=problem,
+                    problem,
                     obj_tol=obj_tol,
                     min_converged_fraction=min_converged_fraction,
                     n_last=20,
@@ -286,7 +310,7 @@ class CompositeAdaptiveTermination(TerminationCollection):
                 )
             )
         if use_hypervolume:
-            terminations.append(
+            members.append(
                 HypervolumeProgressTermination(
                     problem=problem,
                     ref_point=ref_point,
@@ -298,11 +322,11 @@ class CompositeAdaptiveTermination(TerminationCollection):
             )
         if use_multiscale:
             if timescales is None:
-                base_scale = max(5, problem.n_objectives // 5)
-                timescales = [base_scale * (2**i) for i in range(4)]
-            terminations.append(
+                base = max(5, problem.n_objectives // 5)
+                timescales = [base << i for i in range(4)]
+            members.append(
                 MultiScaleStagnationTermination(
-                    problem=problem,
+                    problem,
                     timescales=timescales,
                     stagnation_tol=stagnation_tol,
                     min_scales_stagnant=3,
@@ -310,12 +334,19 @@ class CompositeAdaptiveTermination(TerminationCollection):
                     **kwargs,
                 )
             )
-        super().__init__(problem, *terminations)
+        # the cap lives in its own member so any criterion OR the budget stops
+        super().__init__(
+            problem,
+            MaximumGenerationTermination(problem, n_max_gen=n_max_gen),
+            *members,
+        )
 
 
 class ResourceAwareTermination(Termination):
-    """Wall-clock / evaluation / quality budget stop
-    (reference adaptive_termination.py:461-528)."""
+    """Budget stop on wall-clock, evaluation count, or a quality metric
+    (same criterion as reference adaptive_termination.py:461-528). Each
+    budget is an independent (limit, probe, message) rule checked in
+    sequence."""
 
     def __init__(
         self,
@@ -326,75 +357,72 @@ class ResourceAwareTermination(Termination):
         **kwargs,
     ):
         super().__init__(problem)
+        self._t0: Optional[float] = None
         self.max_time_seconds = max_time_seconds
         self.max_function_evals = max_function_evals
         self.target_quality_threshold = target_quality_threshold
-        self.start_time = None
+
+    def _budget_rules(self, opt):
+        elapsed = time.time() - self._t0
+        yield (
+            self.max_time_seconds,
+            elapsed,
+            f"time limit reached ({elapsed:.1f}s > {self.max_time_seconds}s)",
+        )
+        yield (
+            self.max_function_evals,
+            getattr(opt, "n_eval", getattr(opt, "n_gen", 0)),
+            "evaluation limit reached",
+        )
+        yield (
+            self.target_quality_threshold,
+            getattr(opt, "quality_metric", None),
+            "quality threshold reached",
+        )
 
     def _do_continue(self, opt):
-        if self.start_time is None:
-            self.start_time = time.time()
-        if self.max_time_seconds is not None:
-            elapsed = time.time() - self.start_time
-            if elapsed > self.max_time_seconds:
-                self._log(
-                    f"Optimization terminated: time limit reached "
-                    f"({elapsed:.1f}s > {self.max_time_seconds:.1f}s)"
-                )
-                return False
-        if self.max_function_evals is not None:
-            n_evals = getattr(
-                opt, "n_eval", getattr(opt, "n_gen", 0)
-            )
-            if n_evals > self.max_function_evals:
-                self._log("Optimization terminated: evaluation limit reached")
-                return False
-        if self.target_quality_threshold is not None:
-            quality = getattr(opt, "quality_metric", None)
-            if quality is not None and quality > self.target_quality_threshold:
-                self._log("Optimization terminated: quality threshold reached")
+        if self._t0 is None:
+            self._t0 = time.time()
+        for limit, value, message in self._budget_rules(opt):
+            if limit is not None and value is not None and value > limit:
+                self._log(f"Optimization terminated: {message}")
                 return False
         return True
+
+
+# strategy presets: which composite members to enable, plus overrides
+_STRATEGY_PRESETS: Dict[str, Dict] = {
+    "comprehensive": dict(
+        use_per_objective=True,
+        use_hypervolume=True,
+        use_multiscale=True,
+        hv_tol=1e-6,
+    ),
+    "fast": dict(
+        use_per_objective=False, use_hypervolume=True, use_multiscale=True
+    ),
+    "conservative": dict(
+        use_per_objective=True, use_hypervolume=False, use_multiscale=True
+    ),
+}
 
 
 def create_adaptive_termination(
     problem, n_max_gen: int = 2000, strategy: str = "comprehensive", **kwargs
 ) -> Termination:
-    """Factory (reference adaptive_termination.py:531-612):
-    comprehensive | fast | conservative | simple."""
-    if strategy == "comprehensive":
-        return CompositeAdaptiveTermination(
-            problem=problem,
-            n_max_gen=n_max_gen,
-            use_per_objective=True,
-            use_hypervolume=True,
-            use_multiscale=True,
-            hv_tol=kwargs.pop("hv_tol", 1e-6),
-            **kwargs,
-        )
-    if strategy == "fast":
-        return CompositeAdaptiveTermination(
-            problem=problem,
-            n_max_gen=n_max_gen,
-            use_per_objective=False,
-            use_hypervolume=True,
-            use_multiscale=True,
-            **kwargs,
-        )
-    if strategy == "conservative":
-        return CompositeAdaptiveTermination(
-            problem=problem,
-            n_max_gen=n_max_gen,
-            use_per_objective=True,
-            use_hypervolume=False,
-            use_multiscale=True,
-            **kwargs,
-        )
+    """Factory with the reference's strategy menu
+    (adaptive_termination.py:531-612): comprehensive | fast |
+    conservative build the composite from a preset; simple is the plain
+    hypervolume-progress criterion."""
     if strategy == "simple":
         return HypervolumeProgressTermination(
             problem=problem, n_last=20, nth_gen=5, n_max_gen=n_max_gen, **kwargs
         )
-    raise ValueError(
-        f"Unknown strategy {strategy!r}. Choose from: 'comprehensive', "
-        f"'fast', 'conservative', 'simple'"
-    )
+    preset = _STRATEGY_PRESETS.get(strategy)
+    if preset is None:
+        raise ValueError(
+            f"Unknown strategy {strategy!r}. Choose from: "
+            f"{', '.join([*_STRATEGY_PRESETS, 'simple'])}"
+        )
+    merged = {**preset, **kwargs}
+    return CompositeAdaptiveTermination(problem, n_max_gen=n_max_gen, **merged)

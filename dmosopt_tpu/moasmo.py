@@ -158,11 +158,16 @@ def _optimize_on_device(
         if pop % n_shards == 0:
             state = shard_state(state, pop, mesh, axis=pop_axis)
             optimizer.state = state
-        elif logger is not None:
-            logger.warning(
+        else:
+            import warnings
+
+            msg = (
                 f"popsize {pop} not divisible by mesh axis "
                 f"{pop_axis!r} size {n_shards}; running replicated"
             )
+            warnings.warn(msg)
+            if logger is not None:
+                logger.warning(msg)
 
     def step(state, k):
         x_gen, state = optimizer.generate_strategy(k, state)
