@@ -8,10 +8,11 @@ choice), `MultiFidelityHVTracker` (:446, coarse/medium/fine cadences
 `HypervolumeProgressTermination` (:960) with adaptive reference point.
 
 TPU redesign: every hypervolume evaluation goes through
-`dmosopt_tpu.hv.AdaptiveHyperVolume` (exact for low d, jitted
-Monte Carlo above), and fidelity epsilons map to MC sample counts
-(samples ~ 1/eps^2) instead of the reference's per-algorithm epsilon
-plumbing.
+`dmosopt_tpu.hv.AdaptiveHyperVolume` — exact for low d; above the
+dimension threshold the CI-target-driven FPRAS estimator, where the
+fidelity epsilon is the adaptive stopping target (sampling grows in
+batches until the 95% CI half-width is below epsilon * estimate, up to
+a cap) instead of the reference's per-algorithm epsilon plumbing.
 """
 
 from __future__ import annotations
@@ -58,26 +59,33 @@ class ProgressivePrecisionScheduler:
         return "late"
 
 
-def _samples_for_epsilon(eps: float) -> int:
-    """MC sample count giving ~eps relative standard error (var ~ 1/S)."""
-    return int(np.clip(4.0 / (eps * eps), 2_000, 1_000_000))
-
-
 class HVAlgorithmRouter:
     """Dimension-based algorithm choice (reference hv_termination.py:225-443):
-    exact below the dimension threshold, Monte Carlo above, with the MC
-    sample count derived from the requested epsilon."""
+    exact below the dimension threshold; above it, the CI-target-driven
+    FPRAS estimator — the requested epsilon becomes the adaptive
+    stopping target instead of a static sample count."""
 
     def __init__(self, exact_dim_threshold: int = 10):
         self.exact_dim_threshold = exact_dim_threshold
+        self.last_method = None
+        self.last_n_samples = 0
+        self._hv_cache: dict = {}
 
     def compute(self, F: np.ndarray, ref_point: np.ndarray, epsilon: float) -> float:
-        hv = AdaptiveHyperVolume(
-            ref_point,
-            exact_dim_threshold=self.exact_dim_threshold,
-            mc_samples=_samples_for_epsilon(epsilon),
-        )
-        return hv.compute_hypervolume(F)
+        # one facade per (ref, epsilon): repeated per-fidelity calls reuse
+        # the same estimator (and its PRNG stream) instead of rebuilding
+        cache_key = (tuple(np.asarray(ref_point).ravel()), float(epsilon))
+        hv = self._hv_cache.get(cache_key)
+        if hv is None:
+            hv = self._hv_cache[cache_key] = AdaptiveHyperVolume(
+                ref_point,
+                exact_dim_threshold=self.exact_dim_threshold,
+                epsilon=epsilon,
+            )
+        out = hv.compute_hypervolume(F)
+        self.last_method = hv.last_method
+        self.last_n_samples = hv.last_n_samples
+        return out
 
 
 @dataclass

@@ -12,6 +12,7 @@ host-side (one-shot initial design, not a hot path).
 
 from __future__ import annotations
 
+import functools
 import itertools
 import math
 from functools import partial
@@ -80,11 +81,14 @@ def SobolDesign(n: int, s: int, random=None) -> np.ndarray:
 SOBOL_BITS = 30  # scipy 1.17's direction numbers are 30-bit fractions
 
 
+@functools.lru_cache(maxsize=64)
 def sobol_direction_numbers(dim: int) -> np.ndarray:
     """Joe-Kuo direction numbers for a `dim`-dimensional Sobol sequence,
     (dim, bits) uint32, extracted host-side once so point generation can
     run in-graph (`sobol_block`, which reads the bit width off the table
-    shape)."""
+    shape). Memoized per dimension (hot callers: per-generation TRS
+    perturbations, per-fidelity HV tracking); the returned array is
+    read-only."""
     from scipy.stats import qmc
 
     sampler = qmc.Sobol(d=dim, scramble=False)
@@ -95,7 +99,9 @@ def sobol_direction_numbers(dim: int) -> np.ndarray:
             "Sobol._sv (scipy internals changed?); pin scipy or supply a "
             "direction-number table to sobol_block directly"
         )
-    return np.asarray(sv, dtype=np.uint32)
+    out = np.asarray(sv, dtype=np.uint32)
+    out.setflags(write=False)
+    return out
 
 
 def _xor_reduce(x, axis):
