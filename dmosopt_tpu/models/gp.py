@@ -26,6 +26,7 @@ inputs normalized to the unit box, targets standardized per objective
 from __future__ import annotations
 
 import math
+import warnings
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -114,30 +115,34 @@ class GPFit(NamedTuple):
     nmll: jax.Array  # (d,) final negative log marginal likelihood
 
 
-def _regularized_kernel(X, ls, amp, noise, kernel_fn):
-    """K + (noise + jitter) I, symmetrized, with amplitude-relative jitter.
+def _default_rel_jitter(dtype) -> float:
+    """Amplitude-relative jitter by dtype: f32 Cholesky (the TPU-native
+    dtype) fails outright at the reference's noise floor of 1e-9
+    (`model.py:1194`) — smooth-kernel Gram matrices at moderate
+    lengthscales have eigenvalues below f32 resolution, so f32 carries a
+    1e-4·amp floor (~1% noise on standardized targets). f64 matches the
+    reference's sklearn configuration and needs none."""
+    return 1e-4 if dtype == jnp.float32 else 0.0
 
-    f32 Cholesky (the TPU-native dtype) fails outright at the reference's
-    noise floor of 1e-9 (`model.py:1194`) — smooth-kernel Gram matrices at
-    moderate lengthscales have eigenvalues below f32 resolution. A relative
-    jitter of 1e-4·amp keeps every hyperparameter configuration feasible at
-    the cost of a ~1% noise floor on standardized targets (the reference
-    runs float64 sklearn and never hits this)."""
+
+def _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter):
+    """K + (noise + jitter) I, symmetrized; `rel_jitter` scales with the
+    fitted amplitude (see `_default_rel_jitter`)."""
     N = X.shape[0]
-    jitter = _JITTER + 1e-4 * amp if X.dtype == jnp.float32 else _JITTER
+    jitter = _JITTER + rel_jitter * amp
     K = kernel_fn(X, X, ls, amp)
     K = 0.5 * (K + K.T)
     return K + (noise + jitter) * jnp.eye(N, dtype=X.dtype)
 
 
-def _nmll(params: GPParams, bounds3, X, y, kernel_fn):
+def _nmll(params: GPParams, bounds3, X, y, kernel_fn, rel_jitter):
     """Exact negative log marginal likelihood (per objective)."""
     b_amp, b_ls, b_noise = bounds3
     amp = b_amp.forward(params.u_amp)
     ls = b_ls.forward(params.u_ls)
     noise = b_noise.forward(params.u_noise)
     N = X.shape[0]
-    K = _regularized_kernel(X, ls, amp, noise, kernel_fn)
+    K = _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter)
     L = jnp.linalg.cholesky(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), y)
     return (
@@ -158,7 +163,7 @@ def _select_better(improved, new_params: GPParams, best_params: GPParams) -> GPP
     return GPParams(*(pick(n, b) for n, b in zip(new_params, best_params)))
 
 
-@partial(jax.jit, static_argnames=("kernel", "n_starts", "n_iter", "ard"))
+@partial(jax.jit, static_argnames=("kernel", "n_starts", "n_iter", "ard", "rel_jitter"))
 def fit_gp_batch(
     key: jax.Array,
     X: jax.Array,  # (N, n) unit box
@@ -171,6 +176,7 @@ def fit_gp_batch(
     n_iter: int = 200,
     learning_rate: float = 0.1,
     ard: bool = False,
+    rel_jitter: Optional[float] = None,
 ) -> GPFit:
     """Fit d independent GPs with S random restarts each, as one program.
 
@@ -182,6 +188,8 @@ def fit_gp_batch(
     d = Y.shape[1]
     Lls = n if ard else 1
     dt = X.dtype
+    if rel_jitter is None:
+        rel_jitter = _default_rel_jitter(dt)
 
     b_amp = _Bounds(jnp.asarray(amplitude_bounds[0], dt), jnp.asarray(amplitude_bounds[1], dt))
     b_ls = _Bounds(jnp.asarray(lengthscale_bounds[0], dt), jnp.asarray(lengthscale_bounds[1], dt))
@@ -207,7 +215,7 @@ def fit_gp_batch(
 
     # loss over the (S, d) grid: vmap over restarts, then objectives.
     def loss_one(p, y):
-        return _nmll(p, bounds3, X, y, kernel_fn)
+        return _nmll(p, bounds3, X, y, kernel_fn, rel_jitter)
 
     def loss_grid(params):
         per_obj = jax.vmap(loss_one, in_axes=(0, 1))  # over objectives
@@ -247,7 +255,7 @@ def fit_gp_batch(
     noise = b_noise.forward(take(params.u_noise))
 
     def posterior(amp_i, ls_i, noise_i, y):
-        K = _regularized_kernel(X, ls_i, amp_i, noise_i, kernel_fn)
+        K = _regularized_kernel(X, ls_i, amp_i, noise_i, kernel_fn, rel_jitter)
         L = jnp.linalg.cholesky(K)
         alpha = jax.scipy.linalg.cho_solve((L, True), y)
         return L, alpha
@@ -259,7 +267,7 @@ def fit_gp_batch(
                  y_mean=zeros, y_std=jnp.ones((d,), dt), nmll=nmll)
 
 
-@partial(jax.jit, static_argnames=("kernel", "n_starts", "n_iter"))
+@partial(jax.jit, static_argnames=("kernel", "n_starts", "n_iter", "rel_jitter"))
 def fit_gp_shared(
     key: jax.Array,
     X: jax.Array,  # (N, n) unit box
@@ -271,6 +279,7 @@ def fit_gp_shared(
     n_starts: int = 8,
     n_iter: int = 300,
     learning_rate: float = 0.1,
+    rel_jitter: Optional[float] = None,
 ) -> GPFit:
     """Joint multi-output fit: ONE shared ARD kernel for all d objectives,
     optimized on the summed exact MLL (the statistical coupling of the
@@ -279,6 +288,8 @@ def fit_gp_shared(
     N, n = X.shape
     d = Y.shape[1]
     dt = X.dtype
+    if rel_jitter is None:
+        rel_jitter = _default_rel_jitter(dt)
 
     b_amp = _Bounds(jnp.asarray(amplitude_bounds[0], dt), jnp.asarray(amplitude_bounds[1], dt))
     b_ls = _Bounds(jnp.asarray(lengthscale_bounds[0], dt), jnp.asarray(lengthscale_bounds[1], dt))
@@ -303,7 +314,7 @@ def fit_gp_shared(
         amp = b_amp.forward(p.u_amp)
         ls = b_ls.forward(p.u_ls)
         noise = b_noise.forward(p.u_noise)
-        K = _regularized_kernel(X, ls, amp, noise, kernel_fn)
+        K = _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter)
         L = jnp.linalg.cholesky(K)
         alpha = jax.scipy.linalg.cho_solve((L, True), Y)  # (N, d)
         return (
@@ -341,7 +352,7 @@ def fit_gp_shared(
     ls = b_ls.forward(params.u_ls[best])
     noise = b_noise.forward(params.u_noise[best])
 
-    K = _regularized_kernel(X, ls, amp, noise, kernel_fn)
+    K = _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter)
     L = jnp.linalg.cholesky(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), Y)  # (N, d)
     return GPFit(
@@ -412,18 +423,31 @@ def _prepare_training_data(model, xin, yin, nInput, nOutput, xlb, xub, nan, top_
     return X, Yn, y_mean, y_std
 
 
+def _resolve_dtype(dtype):
+    """"float32"/"float64" (or numpy dtypes) -> jnp dtype; float64
+    requires the global jax x64 mode and enables it on first use."""
+    dt = jnp.float64 if np.dtype(dtype) == np.float64 else jnp.float32
+    if dt == jnp.float64 and not jax.config.jax_enable_x64:
+        warnings.warn(
+            "dtype=float64 enables jax_enable_x64 globally for this process"
+        )
+        jax.config.update("jax_enable_x64", True)
+    return dt
+
+
 class SurrogateMixin:
     """Shared surrogate wrapper surface: unit-box x normalization and the
     reference's ``predict``/``evaluate`` contract on top of a jax-traceable
     ``predict_normalized`` (shared by the exact-GP and SVGP families)."""
 
+    _dtype = jnp.float32  # overridden per instance by dtype="float64"
+
     def normalize_x(self, xin):
-        return (jnp.asarray(xin, jnp.float32) - self.xlb.astype(np.float32)) / (
-            self.xrg.astype(np.float32)
-        )
+        dt = self._dtype
+        return (jnp.asarray(xin, dt) - self.xlb.astype(dt)) / self.xrg.astype(dt)
 
     def predict(self, xin):
-        x = jnp.atleast_2d(jnp.asarray(xin, jnp.float32))
+        x = jnp.atleast_2d(jnp.asarray(xin, self._dtype))
         return self.predict_normalized(self.normalize_x(x))
 
     def evaluate(self, x):
@@ -438,6 +462,13 @@ class GPR_Matern(SurrogateMixin):
 
     API-compatible with reference ``GPR_Matern`` (model.py:1182-1275);
     hyperparameters from batched multi-start Adam instead of SCE-UA.
+
+    ``dtype="float64"`` reproduces the reference's float64 sklearn
+    numerics (no relative jitter; reference noise floor 1e-9) at the
+    cost of enabling the global jax x64 mode — use on CPU or when
+    surrogate precision near the noise floor matters more than MXU
+    throughput. ``rel_jitter`` overrides the dtype default
+    (see `_default_rel_jitter`).
     """
 
     kernel = "matern52"
@@ -463,11 +494,14 @@ class GPR_Matern(SurrogateMixin):
         n_starts: int = 8,
         n_iter: int = 200,
         learning_rate: float = 0.1,
+        dtype="float32",
+        rel_jitter: Optional[float] = None,
         logger=None,
         **kwargs,
     ):
         self.return_mean_variance = return_mean_variance
         self.logger = logger
+        self._dtype = dt = _resolve_dtype(dtype)
         X, Yn, y_mean, y_std = _prepare_training_data(
             self, xin, yin, nInput, nOutput, xlb, xub, nan, top_k
         )
@@ -477,8 +511,8 @@ class GPR_Matern(SurrogateMixin):
         key = as_key(seed)
         fit = fit_gp_batch(
             key,
-            jnp.asarray(X, jnp.float32),
-            jnp.asarray(Yn, jnp.float32),
+            jnp.asarray(X, dt),
+            jnp.asarray(Yn, dt),
             lengthscale_bounds=tuple(length_scale_bounds),
             amplitude_bounds=tuple(constant_kernel_bounds),
             noise_bounds=tuple(noise_level_bounds),
@@ -487,10 +521,11 @@ class GPR_Matern(SurrogateMixin):
             n_iter=n_iter,
             learning_rate=learning_rate,
             ard=bool(anisotropic),
+            rel_jitter=rel_jitter,
         )
         self.fit = fit._replace(
-            y_mean=jnp.asarray(y_mean, jnp.float32),
-            y_std=jnp.asarray(y_std, jnp.float32),
+            y_mean=jnp.asarray(y_mean, dt),
+            y_std=jnp.asarray(y_std, dt),
         )
 
     # jax-traceable prediction on unit-box-normalized input
