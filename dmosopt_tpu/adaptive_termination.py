@@ -345,8 +345,9 @@ class CompositeAdaptiveTermination(TerminationCollection):
 class ResourceAwareTermination(Termination):
     """Budget stop on wall-clock, evaluation count, or a quality metric
     (same criterion as reference adaptive_termination.py:461-528). Each
-    budget is an independent (limit, probe, message) rule checked in
-    sequence."""
+    enabled budget yields an independent (stop, message) rule checked in
+    sequence; the evaluation budget is a hard cap the optimize loops can
+    read via `eval_budget()` to clamp their scan chunks."""
 
     def __init__(
         self,
@@ -363,31 +364,44 @@ class ResourceAwareTermination(Termination):
         self.target_quality_threshold = target_quality_threshold
 
     def _budget_rules(self, opt):
-        elapsed = time.time() - self._t0
-        yield (
-            self.max_time_seconds,
-            elapsed,
-            f"time limit reached ({elapsed:.1f}s > {self.max_time_seconds}s)",
-        )
-        yield (
-            self.max_function_evals,
-            getattr(opt, "n_eval", getattr(opt, "n_gen", 0)),
-            "evaluation limit reached",
-        )
-        yield (
-            self.target_quality_threshold,
-            getattr(opt, "quality_metric", None),
-            "quality threshold reached",
-        )
+        """Yield (stop, message) per enabled budget."""
+        if self.max_time_seconds is not None:
+            elapsed = time.time() - self._t0
+            yield (
+                elapsed > self.max_time_seconds,
+                f"time limit reached ({elapsed:.1f}s > {self.max_time_seconds}s)",
+            )
+        if self.max_function_evals is not None:
+            n_eval = getattr(opt, "n_eval", None)
+            if n_eval is None:
+                raise ValueError(
+                    "max_function_evals is set but the optimize state carries "
+                    "no n_eval counter — refusing to silently count generations"
+                )
+            # a budget of K means "at most K evaluations": stop once consumed,
+            # not once exceeded (the loops clamp chunk sizes to land exactly)
+            yield (
+                n_eval >= self.max_function_evals,
+                f"evaluation limit reached ({n_eval} >= {self.max_function_evals})",
+            )
+        if self.target_quality_threshold is not None:
+            quality = getattr(opt, "quality_metric", None)
+            yield (
+                quality is not None and quality > self.target_quality_threshold,
+                "quality threshold reached",
+            )
 
     def _do_continue(self, opt):
         if self._t0 is None:
             self._t0 = time.time()
-        for limit, value, message in self._budget_rules(opt):
-            if limit is not None and value is not None and value > limit:
+        for stop, message in self._budget_rules(opt):
+            if stop:
                 self._log(f"Optimization terminated: {message}")
                 return False
         return True
+
+    def eval_budget(self):
+        return self.max_function_evals
 
 
 # strategy presets: which composite members to enable, plus overrides

@@ -192,6 +192,19 @@ def _optimize_on_device(
     x_chunks, y_chunks = [], []
     gen = 0
     n_eval = 0
+    # offspring per generation is static but optimizer-specific (SMPSO
+    # emits per-swarm batches); trace it without running a generation
+    noff = max(
+        1,
+        int(
+            jax.eval_shape(
+                lambda k, s: optimizer.generate_strategy(k, s)[0],
+                jax.ShapeDtypeStruct((2,), jnp.uint32),
+                optimizer.state,
+            ).shape[0]
+        ),
+    )
+    eval_budget = getattr(termination, "eval_budget", lambda: None)()
 
     def terminated():
         pop_x, pop_y = optimizer.get_population_strategy(optimizer.state)
@@ -200,6 +213,10 @@ def _optimize_on_device(
 
     while not terminated():
         n = termination_check_interval
+        if eval_budget is not None:
+            # clamp the chunk so the budget stops at the requested count
+            # (evaluations come in whole generations of noff offspring)
+            n = min(n, max(1, -((n_eval - eval_budget) // noff)))
         key, k = jax.random.split(key)
         keys = jax.random.split(k, n)
         state, (x_traj, y_traj) = run_chunk(optimizer.state, keys)
@@ -215,7 +232,6 @@ def _optimize_on_device(
     if not x_chunks:
         # probe eval_fn for the objective-column count (2x nOutput in
         # mean-variance mode)
-        noff = 2 * (optimizer.popsize // 2)
         n_obj_cols = int(
             jax.eval_shape(
                 eval_fn,

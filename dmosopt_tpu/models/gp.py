@@ -125,9 +125,12 @@ def _default_rel_jitter(dtype) -> float:
     return 1e-4 if dtype == jnp.float32 else 0.0
 
 
-def _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter):
+def _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter=None):
     """K + (noise + jitter) I, symmetrized; `rel_jitter` scales with the
-    fitted amplitude (see `_default_rel_jitter`)."""
+    fitted amplitude and defaults from the input dtype (f32-safe floor,
+    see `_default_rel_jitter`) so callers can't silently lose it."""
+    if rel_jitter is None:
+        rel_jitter = _default_rel_jitter(X.dtype)
     N = X.shape[0]
     jitter = _JITTER + rel_jitter * amp
     K = kernel_fn(X, X, ls, amp)

@@ -40,6 +40,13 @@ class Termination:
         if logger is not None:
             logger.info(msg)
 
+    def eval_budget(self):
+        """Hard cap on real-objective evaluations this criterion imposes,
+        or None. The optimize loops use it to clamp scan-chunk sizes so an
+        evaluation budget stops at the requested count instead of at
+        check-interval granularity."""
+        return None
+
 
 class TerminationCollection(Termination):
     """Terminate when ANY member terminates (reference termination.py:61-69)."""
@@ -50,6 +57,12 @@ class TerminationCollection(Termination):
 
     def _do_continue(self, opt):
         return all(term.do_continue(opt) for term in self.terminations)
+
+    def eval_budget(self):
+        budgets = [
+            b for b in (t.eval_budget() for t in self.terminations) if b is not None
+        ]
+        return min(budgets) if budgets else None
 
 
 class MaximumGenerationTermination(Termination):

@@ -165,6 +165,56 @@ def test_resource_aware():
     assert t.has_terminated(_opt(50, x, y))  # n_eval = 50*4 = 200 > 100
 
 
+def test_resource_aware_requires_n_eval():
+    """A set eval budget must refuse states with no n_eval counter rather
+    than silently counting generations."""
+    from collections import namedtuple
+
+    GenOnly = namedtuple("GenOnly", ["n_gen"])
+    t = ResourceAwareTermination(Prob(), max_function_evals=10)
+    with pytest.raises(ValueError, match="n_eval"):
+        t.has_terminated(GenOnly(5))
+
+
+def test_resource_aware_eval_budget_stops_mid_run():
+    """max_function_evals stops the scanned inner loop at the requested
+    evaluation count, not at check-interval granularity."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmosopt_tpu import moasmo
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+
+    pop = 16
+    budget = 5 * pop  # 5 generations' worth: inside the default interval
+    from dmosopt_tpu.optimizers import NSGA2
+
+    opt = NSGA2(popsize=pop, nInput=4, nOutput=2, model=None)
+    rng = np.random.default_rng(11)
+    x0 = rng.uniform(size=(pop, 4)).astype(np.float32)
+    y0 = np.asarray(zdt1(jnp.asarray(x0)))
+    bounds = np.stack([np.zeros(4), np.ones(4)], 1).astype(np.float32)
+    opt.initialize_strategy(x0, y0, bounds, random=1)
+
+    t = ResourceAwareTermination(Prob(), max_function_evals=budget)
+    assert t.eval_budget() == budget
+    x_traj, y_traj, n_gen = moasmo._optimize_on_device(
+        opt, zdt1, 100, jax.random.PRNGKey(0),
+        termination=t, termination_check_interval=50,
+    )
+    n_eval = x_traj.shape[0] * x_traj.shape[1]
+    assert n_eval == budget, (n_eval, budget)
+    assert n_gen == 5
+
+    # the budget also propagates through a composite collection
+    coll = TerminationCollection(
+        Prob(),
+        MaximumGenerationTermination(Prob(), 1000),
+        ResourceAwareTermination(Prob(), max_function_evals=budget),
+    )
+    assert coll.eval_budget() == budget
+
+
 def test_termination_in_moasmo_surrogate_loop():
     """End-to-end: adaptive termination stops the on-device EA early."""
     import jax.numpy as jnp
