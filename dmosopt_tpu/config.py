@@ -58,6 +58,16 @@ default_feasibility_methods = {
 }
 
 
+def as_tuple(value):
+    """Normalize a scalar-or-sequence config value (e.g. optimizer cycling
+    takes one name/kwargs dict or a sequence of them) to a tuple."""
+    from collections.abc import Sequence
+
+    if isinstance(value, Sequence) and not isinstance(value, (str, dict)):
+        return tuple(value)
+    return (value,)
+
+
 def resolve(name_or_path, registry):
     """Resolve a shorthand or import path to an object; pass through callables."""
     if callable(name_or_path):

@@ -22,17 +22,17 @@ reference semantics.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import time
 from functools import partial
-from collections.abc import Sequence
 from typing import Dict, Optional
 
 import numpy as np
 
 from dmosopt_tpu import moasmo as opt
-from dmosopt_tpu.config import import_object_by_path
+from dmosopt_tpu.config import as_tuple as _as_tuple, import_object_by_path
 from dmosopt_tpu.datatypes import (
     EvalRequest,
     OptProblem,
@@ -188,254 +188,282 @@ class DistOptimizer:
             and, with jax_objective, the batch evaluation.
           n_eval_workers: thread-pool width for host objectives.
         """
-        if (random_seed is not None) and (local_random is not None):
-            raise RuntimeError(
-                "Both random_seed and local_random are specified! "
-                "Only one or the other must be specified. "
-            )
         if random_seed is not None:
+            if local_random is not None:
+                raise RuntimeError(
+                    "pass either random_seed or local_random, not both"
+                )
             local_random = np.random.default_rng(seed=random_seed)
 
-        self.opt_id = opt_id
-        self.verbose = verbose
-        self.population_size = population_size
-        self.num_generations = num_generations
+        # plain plumbing: everything that is stored as given
+        self.__dict__.update(
+            opt_id=opt_id,
+            verbose=verbose,
+            population_size=population_size,
+            num_generations=num_generations,
+            distance_metric=distance_metric,
+            dynamic_initial_sampling=dynamic_initial_sampling,
+            dynamic_initial_sampling_kwargs=dynamic_initial_sampling_kwargs,
+            surrogate_method_name=surrogate_method_name,
+            surrogate_custom_training=surrogate_custom_training,
+            surrogate_custom_training_kwargs=surrogate_custom_training_kwargs,
+            sensitivity_method_name=sensitivity_method_name,
+            optimize_mean_variance=optimize_mean_variance,
+            feasibility_method_name=feasibility_method_name,
+            feasibility_method_kwargs=feasibility_method_kwargs,
+            termination_conditions=termination_conditions,
+            metadata=metadata,
+            local_random=local_random,
+            random_seed=random_seed,
+            time_limit=time_limit,
+            mesh=mesh,
+            n_initial=n_initial,
+            initial_maxiter=initial_maxiter,
+            initial_method=initial_method,
+            n_epochs=n_epochs,
+            save_eval=save_eval,
+            obj_fun_args=obj_fun_args,
+            jax_objective=jax_objective,
+            reduce_fun=reduce_fun,
+            reduce_fun_args=reduce_fun_args,
+            constraint_names=constraint_names,
+            feature_dtypes=feature_dtypes,
+        )
         self.resample_fraction = min(float(resample_fraction), 1.0)
-        self.distance_metric = distance_metric
-        self.dynamic_initial_sampling = dynamic_initial_sampling
-        self.dynamic_initial_sampling_kwargs = dynamic_initial_sampling_kwargs
-        self.surrogate_method_name = surrogate_method_name
         self.surrogate_method_kwargs = surrogate_method_kwargs or {}
-        self.surrogate_custom_training = surrogate_custom_training
-        self.surrogate_custom_training_kwargs = surrogate_custom_training_kwargs
-        self.sensitivity_method_name = sensitivity_method_name
         self.sensitivity_method_kwargs = sensitivity_method_kwargs or {}
-        self.optimizer_name = (
-            optimizer_name
-            if isinstance(optimizer_name, Sequence)
-            and not isinstance(optimizer_name, str)
-            else (optimizer_name,)
-        )
-        if optimizer_kwargs is None:
-            optimizer_kwargs = {"mutation_prob": 0.1, "crossover_prob": 0.9}
-        self.optimizer_kwargs = (
+        self.optimizer_name = _as_tuple(optimizer_name)
+        self.optimizer_kwargs = _as_tuple(
             optimizer_kwargs
-            if isinstance(optimizer_kwargs, Sequence)
-            else (optimizer_kwargs,)
+            if optimizer_kwargs is not None
+            else {"mutation_prob": 0.1, "crossover_prob": 0.9}
         )
-        self.optimize_mean_variance = optimize_mean_variance
-        self.feasibility_method_name = feasibility_method_name
-        self.feasibility_method_kwargs = feasibility_method_kwargs
-        self.termination_conditions = termination_conditions
-        self.metadata = metadata
-        self.local_random = local_random
-        self.random_seed = random_seed
-        self.time_limit = time_limit
-        self.mesh = mesh
+        self.save_surrogate_evals_ = save_surrogate_evals
+        self.save_optimizer_params_ = save_optimizer_params
         self.start_time = time.time()
 
         self.logger = logging.getLogger(opt_id)
         if self.verbose:
             self.logger.setLevel(logging.INFO)
 
-        if file_path is None:
-            if problem_parameters is None or space is None:
-                raise ValueError(
-                    "You must specify at least file name `file_path` or problem "
-                    "parameters `problem_parameters` along with a hyperparameter "
-                    "space `space`."
-                )
-            if save:
-                raise ValueError(
-                    "If you want to save you must specify a file name `file_path`."
-                )
-        else:
-            if not os.path.isfile(file_path):
-                if problem_parameters is None or space is None:
-                    raise FileNotFoundError(file_path)
+        self._check_persistence_config(file_path, save, problem_parameters, space)
 
-        param_space = None
-        if space is not None:
-            param_space = ParameterSpace.from_dict(space)
+        # parameter space + archive: either built fresh from `space` /
+        # `problem_parameters` or restored from the checkpoint file
+        param_space = ParameterSpace.from_dict(space) if space is not None else None
         if problem_parameters is not None:
             problem_parameters = ParameterSpace.from_dict(
                 problem_parameters, is_value_only=True
             )
-
-        old_evals = {}
-        max_epoch = -1
-        stored_random_seed = None
-        if file_path is not None and os.path.isfile(file_path):
-            from dmosopt_tpu.storage import init_from_h5
-
-            (
-                stored_random_seed,
-                max_epoch,
-                old_evals,
-                param_space,
-                objective_names,
-                feature_dtypes,
-                constraint_names,
-                problem_parameters,
-                problem_ids,
-            ) = init_from_h5(
-                file_path,
-                param_space.parameter_names if param_space is not None else None,
-                opt_id,
-                self.logger,
-            )
-        if stored_random_seed is not None:
-            if local_random is not None:
-                self.logger.warning("Using saved random seed to create local RNG. ")
-            self.local_random = np.random.default_rng(seed=stored_random_seed)
+        restored = self._restore_from_file(file_path, param_space)
+        self.old_evals = {}
+        self.start_epoch = 0
+        if restored is not None:
+            (seed, max_epoch, self.old_evals, param_space, objective_names,
+             feature_dtypes, constraint_names, problem_parameters,
+             problem_ids) = restored
+            self.feature_dtypes = feature_dtypes
+            self.constraint_names = constraint_names
+            self.start_epoch = max(max_epoch, 0)
+            if seed is not None:
+                if local_random is not None:
+                    self.logger.warning(
+                        "checkpoint carries a random seed; it takes "
+                        "precedence over the provided RNG"
+                    )
+                self.local_random = np.random.default_rng(seed=seed)
         if self.local_random is None:
             self.local_random = as_generator(random_seed)
 
-        if problem_parameters is not None and param_space is not None:
-            assert set(param_space.parameter_names).isdisjoint(
-                set(problem_parameters.parameter_names)
+        if param_space is None or param_space.n_parameters == 0:
+            raise ValueError("empty parameter space")
+        if objective_names is None:
+            raise ValueError("objective_names is required")
+        if problem_parameters is not None and not set(
+            param_space.parameter_names
+        ).isdisjoint(problem_parameters.parameter_names):
+            raise ValueError(
+                "problem_parameters and space must not share parameter names"
             )
 
-        assert param_space is not None and param_space.n_parameters > 0
         self.param_space = param_space
         self.param_names = param_space.parameter_names
-
-        assert objective_names is not None
         self.objective_names = objective_names
-
-        has_problem_ids = problem_ids is not None
-        if not has_problem_ids:
-            problem_ids = set([0])
-
-        self.n_initial = n_initial
-        self.initial_maxiter = initial_maxiter
-        self.initial_method = initial_method
         self.problem_parameters = problem_parameters
         self.file_path, self.save = file_path, save
-
-        for okw in self.optimizer_kwargs:
-            if okw is None:
-                continue
-            di_crossover = okw.get("di_crossover", None)
-            if isinstance(di_crossover, dict):
-                okw["di_crossover"] = param_space.flatten(di_crossover)
-            di_mutation = okw.get("di_mutation", None)
-            if isinstance(di_mutation, dict):
-                okw["di_mutation"] = param_space.flatten(di_mutation)
+        self.has_problem_ids = problem_ids is not None
+        self.problem_ids = problem_ids if self.has_problem_ids else set([0])
+        self._flatten_di_kwargs(param_space)
 
         self.epoch_count = 0
-        self.start_epoch = 0
-        if max_epoch > 0:
-            self.start_epoch = max_epoch
-
-        self.n_epochs = n_epochs
-        self.save_eval = save_eval
-        self.save_surrogate_evals_ = save_surrogate_evals
-        self.save_optimizer_params_ = save_optimizer_params
         self.saved_eval_count = 0
         self.eval_count = 0
-
-        self.obj_fun_args = obj_fun_args
-        self.jax_objective = jax_objective
-        if has_problem_ids:
-            self.eval_fun = partial(
-                eval_obj_fun_mp,
-                obj_fun,
-                self.problem_parameters,
-                self.param_space,
-                nested_parameter_space,
-                self.obj_fun_args,
-                problem_ids,
-            )
-        else:
-            self.eval_fun = partial(
-                eval_obj_fun_sp,
-                obj_fun,
-                self.problem_parameters,
-                self.param_space,
-                nested_parameter_space,
-                self.obj_fun_args,
-                0,
-            )
-
-        self.reduce_fun = reduce_fun
-        self.reduce_fun_args = reduce_fun_args
-
-        self.old_evals = old_evals
-        self.has_problem_ids = has_problem_ids
-        self.problem_ids = problem_ids
-
         self.optimizer_dict = {}
         self.storage_dict = {}
+        self.stats = {}
 
-        self.feature_constructor = lambda x: x
-        if feature_class is not None:
-            self.feature_constructor = import_object_by_path(feature_class)
-        self.feature_dtypes = feature_dtypes
-        self.feature_names = None
-        if feature_dtypes is not None:
-            self.feature_names = [dt[0] for dt in feature_dtypes]
-        self.constraint_names = constraint_names
+        self.feature_constructor = (
+            import_object_by_path(feature_class)
+            if feature_class is not None
+            else (lambda x: x)
+        )
+        self.feature_names = (
+            [dt[0] for dt in self.feature_dtypes]
+            if self.feature_dtypes is not None
+            else None
+        )
 
-        # evaluation backend (the distwq replacement)
-        if evaluator is not None:
-            self.evaluator = evaluator
-        elif jax_objective:
-            self.evaluator = JaxBatchEvaluator(
+        # per-point objective wrapper (host-Python objectives); the
+        # multi-problem variant shares one call across problem ids
+        wrapper, target = (
+            (eval_obj_fun_mp, self.problem_ids)
+            if self.has_problem_ids
+            else (eval_obj_fun_sp, 0)
+        )
+        self.eval_fun = partial(
+            wrapper, obj_fun, self.problem_parameters, self.param_space,
+            nested_parameter_space, self.obj_fun_args, target,
+        )
+
+        self.evaluator = evaluator if evaluator is not None else (
+            # the distwq replacement: one jitted mesh-sharded batch call
+            # for jax objectives, a thread pool for host objectives
+            JaxBatchEvaluator(
                 obj_fun,
-                problem_ids=sorted(problem_ids),
+                problem_ids=sorted(self.problem_ids),
                 mesh=mesh,
-                has_features=feature_dtypes is not None,
-                has_constraints=constraint_names is not None,
+                has_features=self.feature_dtypes is not None,
+                has_constraints=self.constraint_names is not None,
             )
-        else:
-            self.evaluator = HostFunEvaluator(
-                self.eval_fun, n_workers=n_eval_workers
-            )
+            if jax_objective
+            else HostFunEvaluator(self.eval_fun, n_workers=n_eval_workers)
+        )
 
         if self.save and file_path is not None and not os.path.isfile(file_path):
             from dmosopt_tpu.storage import init_h5
 
             init_h5(
-                self.opt_id,
-                self.problem_ids,
-                self.has_problem_ids,
-                self.param_space,
-                self.param_names,
-                self.objective_names,
-                self.feature_dtypes,
-                self.constraint_names,
-                self.problem_parameters,
-                self.metadata,
-                self.random_seed,
+                self.opt_id, self.problem_ids, self.has_problem_ids,
+                self.param_space, self.param_names, self.objective_names,
+                self.feature_dtypes, self.constraint_names,
+                self.problem_parameters, self.metadata, self.random_seed,
                 self.file_path,
                 surrogate_mean_variance=self.optimize_mean_variance,
             )
 
-        self.stats = {}
+    # --------------------------------------------------------- init helpers
+
+    @staticmethod
+    def _check_persistence_config(file_path, save, problem_parameters, space):
+        """A run needs a problem definition from somewhere: inline
+        (`space` + `problem_parameters`) or a checkpoint file."""
+        definition_inline = problem_parameters is not None and space is not None
+        if file_path is None:
+            if not definition_inline:
+                raise ValueError(
+                    "no problem definition: pass `space` and "
+                    "`problem_parameters`, or a checkpoint `file_path`"
+                )
+            if save:
+                raise ValueError("save=True requires a `file_path`")
+        elif not os.path.isfile(file_path) and not definition_inline:
+            raise FileNotFoundError(file_path)
+
+    def _restore_from_file(self, file_path, param_space):
+        """Load the checkpoint tuple, or None for a fresh run."""
+        if file_path is None or not os.path.isfile(file_path):
+            return None
+        from dmosopt_tpu.storage import init_from_h5
+
+        known_names = (
+            param_space.parameter_names if param_space is not None else None
+        )
+        return init_from_h5(file_path, known_names, self.opt_id, self.logger)
+
+    def _flatten_di_kwargs(self, param_space):
+        """Per-parameter distribution indices may be given as nested dicts;
+        flatten them to arrays in parameter order."""
+        for okw in self.optimizer_kwargs:
+            if not okw:
+                continue
+            for di_key in ("di_crossover", "di_mutation"):
+                if isinstance(okw.get(di_key), dict):
+                    okw[di_key] = param_space.flatten(okw[di_key])
 
     # -------------------------------------------------------------- stats
 
     def get_stats(self):
-        for problem_id in self.problem_ids:
-            if problem_id in self.optimizer_dict:
-                self.stats.update(
-                    {
-                        f"{problem_id}_{k}" if problem_id > 0 else k: v
-                        for k, v in self.optimizer_dict[problem_id].stats.items()
-                    }
-                )
-        result = {}
-        for key in self.stats:
-            if not key.endswith("_start") and not key.endswith("_end"):
-                result[key] = self.stats[key]
+        """Merged per-problem stats; paired `<phase>_start`/`<phase>_end`
+        timestamps collapse into a single `<phase>` duration."""
+        for pid in self.problem_ids:
+            strategy = self.optimizer_dict.get(pid)
+            if strategy is None:
                 continue
-            name, period = key.rsplit("_", 1)
-            if period == "start" and f"{name}_end" in self.stats:
-                result[name] = self.stats[f"{name}_end"] - self.stats[key]
-        return result
+            prefix = f"{pid}_" if pid > 0 else ""
+            self.stats.update(
+                (prefix + k, v) for k, v in strategy.stats.items()
+            )
+        out = {}
+        for key, value in self.stats.items():
+            name, _, period = key.rpartition("_")
+            if period == "start":
+                end = self.stats.get(f"{name}_end")
+                if end is not None:
+                    out[name] = end - value
+            elif period != "end":
+                out[key] = value
+        return out
 
     # ----------------------------------------------------- strategy setup
+
+    def _restored_initial(self, problem_id):
+        """Archive tuple (epochs, x, y, f, c) for a problem restored from
+        the checkpoint, or None when this problem starts fresh."""
+        evals = self.old_evals.get(problem_id)
+        if not evals:
+            return None
+        epochs = None
+        if evals[0].epoch is not None:
+            epochs = np.concatenate([e.epoch for e in evals], axis=None)
+        x = np.vstack([e.parameters for e in evals])
+        y = np.vstack([e.objectives for e in evals])
+        f = None
+        if self.feature_dtypes is not None:
+            # stored features may be scalar records, flat rows, or shaped
+            # rows; normalize each to one row before stacking
+            rows = [np.atleast_1d(np.asarray(e.features)).ravel() for e in evals]
+            f = self.feature_constructor(np.stack(rows, axis=0))
+        c = None
+        if self.constraint_names is not None:
+            c = np.vstack([e.constraints for e in evals])
+        return (epochs, x, y, f, c)
+
+    def _strategy_spec(self):
+        """Constructor kwargs shared by every per-problem strategy."""
+        return dict(
+            resample_fraction=self.resample_fraction,
+            population_size=self.population_size,
+            num_generations=self.num_generations,
+            initial_maxiter=self.initial_maxiter,
+            initial_method=self.initial_method,
+            distance_metric=self.distance_metric,
+            surrogate_method_name=self.surrogate_method_name,
+            surrogate_method_kwargs=self.surrogate_method_kwargs,
+            surrogate_custom_training=self.surrogate_custom_training,
+            surrogate_custom_training_kwargs=self.surrogate_custom_training_kwargs,
+            sensitivity_method_name=self.sensitivity_method_name,
+            sensitivity_method_kwargs=self.sensitivity_method_kwargs,
+            optimizer_name=self.optimizer_name,
+            optimizer_kwargs=self.optimizer_kwargs,
+            feasibility_method_name=self.feasibility_method_name,
+            feasibility_method_kwargs=self.feasibility_method_kwargs,
+            termination_conditions=self.termination_conditions,
+            optimize_mean_variance=self.optimize_mean_variance,
+            local_random=self.local_random,
+            logger=self.logger,
+            file_path=self.file_path,
+            mesh=self.mesh,
+        )
 
     def initialize_strategy(self):
         opt_prob = OptProblem(
@@ -448,71 +476,20 @@ class DistOptimizer:
             self.eval_fun,
             logger=self.logger,
         )
-        dim = len(self.param_names)
-        initial = None
+        spec = self._strategy_spec()
+        any_restored = False
         for problem_id in self.problem_ids:
-            initial = None
-            if problem_id in self.old_evals and len(self.old_evals[problem_id]) > 0:
-                evals = self.old_evals[problem_id]
-                old_eval_epochs = [e.epoch for e in evals]
-                epochs = None
-                if len(old_eval_epochs) > 0 and old_eval_epochs[0] is not None:
-                    epochs = np.concatenate(old_eval_epochs, axis=None)
-                x = np.vstack([e.parameters for e in evals])
-                y = np.vstack([e.objectives for e in evals])
-                f = None
-                if self.feature_dtypes is not None:
-                    e0 = evals[0]
-                    f_shape = (
-                        e0.features.shape[0] if len(e0.features.shape) > 0 else 0
-                    )
-                    if f_shape == 0:
-                        old_eval_fs = [[e.features] for e in evals]
-                    elif f_shape == 1:
-                        old_eval_fs = [e.features for e in evals]
-                    else:
-                        old_eval_fs = [
-                            e.features.reshape((1, f_shape)) for e in evals
-                        ]
-                    f = self.feature_constructor(
-                        np.concatenate(old_eval_fs, axis=0)
-                    )
-                c = None
-                if self.constraint_names is not None:
-                    c = np.vstack([e.constraints for e in evals])
-                initial = (epochs, x, y, f, c)
-                if len(x) >= self.n_initial * dim:
-                    self.start_epoch += 1
-
+            initial = self._restored_initial(problem_id)
+            if initial is not None and initial[1].shape[0] >= (
+                self.n_initial * len(self.param_names)
+            ):
+                self.start_epoch += 1
+            any_restored = any_restored or initial is not None
             self.optimizer_dict[problem_id] = DistOptStrategy(
-                opt_prob,
-                self.n_initial,
-                initial=initial,
-                resample_fraction=self.resample_fraction,
-                population_size=self.population_size,
-                num_generations=self.num_generations,
-                initial_maxiter=self.initial_maxiter,
-                initial_method=self.initial_method,
-                distance_metric=self.distance_metric,
-                surrogate_method_name=self.surrogate_method_name,
-                surrogate_method_kwargs=self.surrogate_method_kwargs,
-                surrogate_custom_training=self.surrogate_custom_training,
-                surrogate_custom_training_kwargs=self.surrogate_custom_training_kwargs,
-                sensitivity_method_name=self.sensitivity_method_name,
-                sensitivity_method_kwargs=self.sensitivity_method_kwargs,
-                optimizer_name=self.optimizer_name,
-                optimizer_kwargs=self.optimizer_kwargs,
-                feasibility_method_name=self.feasibility_method_name,
-                feasibility_method_kwargs=self.feasibility_method_kwargs,
-                termination_conditions=self.termination_conditions,
-                optimize_mean_variance=self.optimize_mean_variance,
-                local_random=self.local_random,
-                logger=self.logger,
-                file_path=self.file_path,
-                mesh=self.mesh,
+                opt_prob, self.n_initial, initial=initial, **spec
             )
             self.storage_dict[problem_id] = []
-        if initial is not None:
+        if any_restored:
             self.print_best()
 
     # -------------------------------------------------------- persistence
@@ -547,19 +524,10 @@ class DistOptimizer:
 
         if len(finished_evals) > 0:
             save_to_h5(
-                self.opt_id,
-                self.problem_ids,
-                self.has_problem_ids,
-                self.objective_names,
-                self.feature_dtypes,
-                self.constraint_names,
-                self.param_space,
-                finished_evals,
-                self.problem_parameters,
-                self.metadata,
-                self.random_seed,
-                self.file_path,
-                self.logger,
+                self.opt_id, self.problem_ids, self.has_problem_ids,
+                self.objective_names, self.feature_dtypes, self.constraint_names,
+                self.param_space, finished_evals, self.problem_parameters,
+                self.metadata, self.random_seed, self.file_path, self.logger,
                 surrogate_mean_variance=self.optimize_mean_variance,
             )
 
@@ -568,16 +536,9 @@ class DistOptimizer:
             from dmosopt_tpu.storage import save_surrogate_evals_to_h5
 
             save_surrogate_evals_to_h5(
-                self.opt_id,
-                problem_id,
-                self.param_names,
-                self.objective_names,
-                epoch,
-                gen_index,
-                x_sm,
-                y_sm,
-                self.file_path,
-                self.logger,
+                self.opt_id, problem_id, self.param_names,
+                self.objective_names, epoch, gen_index, x_sm, y_sm,
+                self.file_path, self.logger,
             )
 
     def save_optimizer_params(self, problem_id, epoch, optimizer_name, optimizer_params):
@@ -753,6 +714,72 @@ class DistOptimizer:
 
         return self.eval_count, self.saved_eval_count
 
+    def _drain_dynamic_initial_samples(self, distopt):
+        """Epoch-0 hook: a user-supplied sampler decides, round by round,
+        whether the initial design needs more evaluated points (e.g. to
+        reach a feasibility quota) before the first surrogate fit. The
+        keyword names are the reference's public sampler interface
+        (dmosopt.py:1357-1402)."""
+        sampler_fn = import_object_by_path(self.dynamic_initial_sampling)
+        design = dict(
+            n_initial=self.n_initial,
+            maxiter=self.initial_maxiter,
+            method=self.initial_method,
+            param_names=distopt.prob.param_names,
+            xlb=distopt.prob.lb,
+            xub=distopt.prob.ub,
+        )
+        extra = self.dynamic_initial_sampling_kwargs or {}
+        for round_idx in itertools.count():
+            proposal = opt.xinit(
+                self.n_initial,
+                distopt.prob.param_names,
+                distopt.prob.lb,
+                distopt.prob.ub,
+                nPrevious=None,
+                maxiter=self.initial_maxiter,
+                method=self.initial_method,
+                local_random=self.local_random,
+                logger=self.logger,
+            )
+            batch = sampler_fn(
+                file_path=self.file_path,
+                iteration=round_idx,
+                evaluated_samples=distopt.completed,
+                next_samples=proposal,
+                sampler=design,
+                **extra,
+            )
+            if batch is None:
+                return
+            for row in np.atleast_2d(np.asarray(batch)):
+                distopt.append_request(EvalRequest(row, None, 0))
+            self._process_requests()
+
+    def _log_surrogate_accuracy(self, problem_id, fit_epoch, completed_evals):
+        """Per-objective MAE of the surrogate's predictions against the
+        real evaluations they scheduled (the reference logs the same
+        quantity per epoch, dmosopt.py:1420-1449) — one vectorized masked
+        mean over the (n, d) error matrix."""
+        _, y, pred, _, c = completed_evals
+        if c is not None:
+            keep = np.all(c > 0.0, axis=1)
+            if keep.any():
+                y, pred = y[keep], pred[keep]
+        if y.shape[0] == 0:
+            return
+        pred = pred[:, : y.shape[1]]  # mean columns in mean-variance mode
+        valid = np.isfinite(y) & np.isfinite(pred)
+        counts = valid.sum(axis=0)
+        err = np.where(valid, np.abs(y - pred), 0.0).sum(axis=0)
+        mae = [
+            float(e / k) if k else float("nan") for e, k in zip(err, counts)
+        ]
+        self.logger.info(
+            f"surrogate accuracy at epoch {fit_epoch} for "
+            f"problem {problem_id} was {mae}"
+        )
+
     def run_epoch(self, completed_epoch: bool = False):
         """One full epoch: drain initial requests, run per-problem epoch
         state machines to completion (reference dmosopt.py:1341-1470)."""
@@ -764,47 +791,8 @@ class DistOptimizer:
 
         for problem_id in self.problem_ids:
             distopt = self.optimizer_dict[problem_id]
-
             if self.dynamic_initial_sampling is not None and self.epoch_count == 0:
-                dynamic_initial_sampler = import_object_by_path(
-                    self.dynamic_initial_sampling
-                )
-                dyn_sample_iter_count = 0
-                while True:
-                    more_samples = dynamic_initial_sampler(
-                        file_path=self.file_path,
-                        iteration=dyn_sample_iter_count,
-                        evaluated_samples=distopt.completed,
-                        next_samples=opt.xinit(
-                            self.n_initial,
-                            distopt.prob.param_names,
-                            distopt.prob.lb,
-                            distopt.prob.ub,
-                            nPrevious=None,
-                            maxiter=self.initial_maxiter,
-                            method=self.initial_method,
-                            local_random=self.local_random,
-                            logger=self.logger,
-                        ),
-                        sampler={
-                            "n_initial": self.n_initial,
-                            "maxiter": self.initial_maxiter,
-                            "method": self.initial_method,
-                            "param_names": distopt.prob.param_names,
-                            "xlb": distopt.prob.lb,
-                            "xub": distopt.prob.ub,
-                        },
-                        **(self.dynamic_initial_sampling_kwargs or {}),
-                    )
-                    if more_samples is None:
-                        break
-                    for i in range(more_samples.shape[0]):
-                        distopt.append_request(
-                            EvalRequest(more_samples[i, :], None, 0)
-                        )
-                    self._process_requests()
-                    dyn_sample_iter_count += 1
-
+                self._drain_dynamic_initial_samples(distopt)
             distopt.initialize_epoch(epoch)
 
         self.stats["init_sampling_end"] = time.time()
@@ -826,38 +814,10 @@ class DistOptimizer:
                     continue
                 res = strategy_value
 
-                # prediction accuracy of completed evaluations
-                # (reference dmosopt.py:1420-1449)
                 if (completed_evals is not None) and (epoch > 1):
-                    x_completed, y_completed, pred_completed = (
-                        completed_evals[0],
-                        completed_evals[1],
-                        completed_evals[2],
+                    self._log_surrogate_accuracy(
+                        problem_id, epoch - 1, completed_evals
                     )
-                    c_completed = completed_evals[4]
-                    if c_completed is not None:
-                        feasible = np.argwhere(
-                            np.all(c_completed > 0.0, axis=1)
-                        ).ravel()
-                        if len(feasible) > 0:
-                            x_completed = x_completed[feasible, :]
-                            y_completed = y_completed[feasible, :]
-                            pred_completed = pred_completed[feasible, :]
-                    if x_completed.shape[0] > 0:
-                        mae = []
-                        for i in range(y_completed.shape[1]):
-                            y_i = y_completed[:, i]
-                            pred_i = pred_completed[:, i]
-                            valid = ~np.isnan(y_i) & ~np.isnan(pred_i)
-                            mae.append(
-                                float(np.mean(np.abs(y_i[valid] - pred_i[valid])))
-                                if valid.any()
-                                else np.nan
-                            )
-                        self.logger.info(
-                            f"surrogate accuracy at epoch {epoch - 1} for "
-                            f"problem {problem_id} was {mae}"
-                        )
 
                 if advance_epoch and epoch > 0:
                     if self.save and self.save_surrogate_evals_:

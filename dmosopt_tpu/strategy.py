@@ -23,6 +23,7 @@ from typing import Dict, Optional, Union
 import numpy as np
 
 from dmosopt_tpu import moasmo as opt
+from dmosopt_tpu.config import as_tuple
 from dmosopt_tpu.datatypes import (
     EpochResults,
     EvalEntry,
@@ -37,12 +38,20 @@ import jax.numpy as jnp
 
 
 def anyclose(x, Y, rtol: float = 1e-4, atol: float = 1e-4) -> bool:
-    """True if any row of Y is elementwise-close to x
-    (reference: dmosopt/dmosopt.py:36-40)."""
-    for i in range(Y.shape[0]):
-        if np.allclose(x, Y[i, :], rtol=rtol, atol=atol):
-            return True
-    return False
+    """True if any row of Y is elementwise-close to x — one vectorized
+    comparison over the archive (same tolerance semantics as the
+    reference's per-row allclose loop, dmosopt/dmosopt.py:36-40)."""
+    x = np.asarray(x)
+    return bool(
+        np.any(np.all(np.abs(Y - x) <= atol + rtol * np.abs(Y), axis=1))
+    )
+
+
+def _vstack_or_init(base, rows):
+    """Append rows to a growing archive column (None = first batch)."""
+    if rows is None:
+        return base
+    return rows if base is None else np.concatenate((base, rows), axis=0)
 
 
 class DistOptStrategy:
@@ -74,71 +83,51 @@ class DistOptStrategy:
         file_path=None,
         mesh=None,
     ):
-        self.local_random = local_random
-        self.logger = logger
-        self.file_path = file_path
-        self.mesh = mesh
-        self.feasibility_method_name = feasibility_method_name
+        self.__dict__.update(
+            prob=prob,
+            local_random=local_random,
+            logger=logger,
+            file_path=file_path,
+            mesh=mesh,
+            feasibility_method_name=feasibility_method_name,
+            surrogate_method_name=surrogate_method_name,
+            surrogate_custom_training=surrogate_custom_training,
+            surrogate_custom_training_kwargs=surrogate_custom_training_kwargs,
+            sensitivity_method_name=sensitivity_method_name,
+            optimize_mean_variance=optimize_mean_variance,
+            distance_metric=distance_metric,
+            resample_fraction=resample_fraction,
+            num_generations=num_generations,
+            population_size=population_size,
+        )
         self.feasibility_method_kwargs = feasibility_method_kwargs or {}
-        self.surrogate_method_name = surrogate_method_name
         self.surrogate_method_kwargs = surrogate_method_kwargs or {}
-        self.surrogate_custom_training = surrogate_custom_training
-        self.surrogate_custom_training_kwargs = surrogate_custom_training_kwargs
-        self.sensitivity_method_name = sensitivity_method_name
         self.sensitivity_method_kwargs = sensitivity_method_kwargs or {}
-        self.optimizer_name = (
-            optimizer_name
-            if isinstance(optimizer_name, Sequence)
-            and not isinstance(optimizer_name, str)
-            else (optimizer_name,)
-        )
-        if optimizer_kwargs is None:
-            optimizer_kwargs = {"crossover_prob": 0.9, "mutation_prob": 0.1}
-        self.optimizer_kwargs = (
+        self.optimizer_name = as_tuple(optimizer_name)
+        self.optimizer_kwargs = as_tuple(
             optimizer_kwargs
-            if isinstance(optimizer_kwargs, Sequence)
-            else (optimizer_kwargs,)
+            if optimizer_kwargs is not None
+            else {"crossover_prob": 0.9, "mutation_prob": 0.1}
         )
-        self.optimize_mean_variance = optimize_mean_variance
         self.optimizer_iter = itertools.cycle(range(len(self.optimizer_name)))
-        self.distance_metric = distance_metric
-        self.prob = prob
+
         self.completed = []
         self.t = None
-        if initial is None:
-            self.x = None
-            self.y = None
-            self.f = None
-            self.c = None
-        else:
-            epochs, self.x, self.y, self.f, self.c = initial
-        self.resample_fraction = resample_fraction
-        self.num_generations = num_generations
-        self.population_size = population_size
+        self.x = self.y = self.f = self.c = None
+        if initial is not None:
+            _epochs, self.x, self.y, self.f, self.c = initial
 
-        self.termination = None
-        if callable(termination_conditions):
-            self.termination = termination_conditions(prob)
-        elif termination_conditions:
-            from dmosopt_tpu.adaptive_termination import create_adaptive_termination
+        self.termination = self._build_termination(termination_conditions)
 
-            termination_kwargs = {
-                "strategy": "comprehensive",
-                "n_max_gen": num_generations,
-            }
-            if isinstance(termination_conditions, dict):
-                termination_kwargs.update(termination_conditions)
-            self.termination = create_adaptive_termination(prob, **termination_kwargs)
-
-        nPrevious = None
-        if self.x is not None:
-            nPrevious = self.x.shape[0]
+        # seed the request queue with the initial design; on resume, points
+        # already in the restored archive are filtered out lazily
+        n_previous = None if self.x is None else self.x.shape[0]
         xinit = opt.xinit(
             n_initial,
             prob.param_names,
             prob.lb,
             prob.ub,
-            nPrevious=nPrevious,
+            nPrevious=n_previous,
             maxiter=initial_maxiter,
             method=initial_method,
             local_random=self.local_random,
@@ -146,20 +135,35 @@ class DistOptStrategy:
         )
         self.reqs = []
         if xinit is not None:
-            assert xinit.shape[1] == prob.dim
-            if initial is None:
-                self.reqs = [
-                    EvalRequest(xinit[i, :], None, 0) for i in range(xinit.shape[0])
-                ]
-            else:
-                # resume: skip re-seeded points that were already evaluated
-                self.reqs = filter(
-                    lambda req: not anyclose(req.parameters, self.x),
-                    [EvalRequest(xinit[i, :], None, 0) for i in range(xinit.shape[0])],
+            if xinit.shape[1] != prob.dim:
+                raise ValueError(
+                    f"initial design dim {xinit.shape[1]} != problem dim {prob.dim}"
                 )
+            seeded = (EvalRequest(row, None, 0) for row in xinit)
+            self.reqs = (
+                list(seeded)
+                if initial is None
+                else filter(
+                    lambda req: not anyclose(req.parameters, self.x), seeded
+                )
+            )
         self.opt_gen = None
         self.epoch_index = -1
         self.stats = {}
+
+    def _build_termination(self, conditions):
+        """None/falsy -> no criterion; a callable -> called with the
+        problem; a dict/True -> the adaptive composite with overrides."""
+        if not conditions:
+            return None
+        if callable(conditions):
+            return conditions(self.prob)
+        from dmosopt_tpu.adaptive_termination import create_adaptive_termination
+
+        overrides = conditions if isinstance(conditions, dict) else {}
+        spec = dict(strategy="comprehensive", n_max_gen=self.num_generations)
+        spec.update(overrides)
+        return create_adaptive_termination(self.prob, **spec)
 
     # ------------------------------------------------------- request queue
 
@@ -231,103 +235,108 @@ class DistOptStrategy:
         if self.f is not None:
             self.f = self.f[perm]
 
+    def _update_eval_time_stats(self, times):
+        """Summary statistics over positive per-eval wall-clock times."""
+        self.t = _vstack_or_init(self.t, times)
+        ts = self.t[self.t > 0.0]
+        reducers = dict(
+            eval_min=np.min, eval_max=np.max, eval_mean=np.mean,
+            eval_std=np.std, eval_sum=np.sum, eval_median=np.median,
+        )
+        self.stats.update(
+            (k, fn(ts) if ts.size else -1) for k, fn in reducers.items()
+        )
+
     def _update_evals(self):
         """Fold completed evaluations into the archive once the request
-        queue is drained (reference dmosopt.py:229-305)."""
-        result = None
-        if len(self.completed) > 0 and not self.has_requests():
-            x_completed = np.vstack([e.parameters for e in self.completed])
-            y_completed = np.vstack([e.objectives for e in self.completed])
-            n_obj_cols = (
-                2 * self.prob.n_objectives
-                if self.optimize_mean_variance
-                else self.prob.n_objectives
-            )
-            y_predicted = np.vstack(
-                [
-                    [np.nan] * n_obj_cols if e.prediction is None else e.prediction
-                    for e in self.completed
-                ]
-            )
+        queue is drained (same transition as reference dmosopt.py:229-305,
+        restructured around a per-column append helper)."""
+        if not self.completed or self.has_requests():
+            return None
 
-            f_completed = None
-            if self.prob.n_features is not None:
-                f_completed = np.concatenate(
-                    [e.features for e in self.completed], axis=0
-                )
-            c_completed = None
-            if self.prob.n_constraints is not None:
-                c_completed = np.vstack([e.constraints for e in self.completed])
+        done = self.completed
+        n_pred_cols = self.prob.n_objectives * (
+            2 if self.optimize_mean_variance else 1
+        )
+        nan_pred = [np.nan] * n_pred_cols
+        batch = dict(
+            x=np.vstack([e.parameters for e in done]),
+            y=np.vstack([e.objectives for e in done]),
+            f=(
+                np.concatenate([e.features for e in done], axis=0)
+                if self.prob.n_features is not None
+                else None
+            ),
+            c=(
+                np.vstack([e.constraints for e in done])
+                if self.prob.n_constraints is not None
+                else None
+            ),
+        )
+        pred = np.vstack(
+            [nan_pred if e.prediction is None else e.prediction for e in done]
+        )
 
-            assert x_completed.shape[1] == self.prob.dim
-            assert y_completed.shape[1] == self.prob.n_objectives
-            if self.prob.n_constraints is not None:
-                assert c_completed.shape[1] == self.prob.n_constraints
-
-            if self.x is None:
-                self.x = x_completed
-                self.y = y_completed
-                self.f = f_completed
-                self.c = c_completed
-            else:
-                self.x = np.vstack((self.x, x_completed))
-                self.y = np.vstack((self.y, y_completed))
-                if self.prob.n_features is not None:
-                    self.f = np.concatenate((self.f, f_completed), axis=0)
-                if self.prob.n_constraints is not None:
-                    self.c = np.vstack((self.c, c_completed))
-
-            t_completed = np.vstack([e.time for e in self.completed])
-            self.t = (
-                t_completed if self.t is None else np.vstack((self.t, t_completed))
-            )
-            ts = self.t[self.t > 0.0]
-            if len(ts) > 0:
-                self.stats.update(
-                    {
-                        "eval_min": np.min(ts),
-                        "eval_max": np.max(ts),
-                        "eval_mean": np.mean(ts),
-                        "eval_std": np.std(ts),
-                        "eval_sum": np.sum(ts),
-                        "eval_median": np.median(ts),
-                    }
-                )
-            else:
-                self.stats.update(
-                    {k: -1 for k in (
-                        "eval_min", "eval_max", "eval_mean",
-                        "eval_std", "eval_sum", "eval_median",
-                    )}
+        expected_cols = dict(
+            x=self.prob.dim, y=self.prob.n_objectives, c=self.prob.n_constraints
+        )
+        for col, width in expected_cols.items():
+            if batch[col] is not None and batch[col].shape[1] != width:
+                raise ValueError(
+                    f"completed evals: {col} has {batch[col].shape[1]} "
+                    f"columns, expected {width}"
                 )
 
-            self._remove_duplicate_evals()
-            self.completed = []
-            result = x_completed, y_completed, y_predicted, f_completed, c_completed
-        return result
+        for col, rows in batch.items():
+            setattr(self, col, _vstack_or_init(getattr(self, col), rows))
+
+        self._update_eval_time_stats(np.vstack([e.time for e in done]))
+        self._remove_duplicate_evals()
+        self.completed = []
+        return batch["x"], batch["y"], pred, batch["f"], batch["c"]
 
     # ------------------------------------------------------- epoch driving
 
-    def initialize_epoch(self, epoch_index: int):
-        assert self.opt_gen is None, (
-            "Optimization generator is active in DistOptStrategy"
-        )
-        optimizer_index = next(self.optimizer_iter)
-        optimizer_kwargs = {}
-        # a single kwargs dict is shared by all cycled optimizers; any other
-        # length mismatch is a config error, not something to wrap silently
+    def _cycled_optimizer(self):
+        """(name, merged kwargs) for this epoch's optimizer. A single
+        kwargs dict is shared by all cycled optimizers; any other length
+        mismatch is a config error, not something to wrap silently."""
         if len(self.optimizer_kwargs) not in (1, len(self.optimizer_name)):
             raise ValueError(
                 f"optimizer_kwargs has {len(self.optimizer_kwargs)} entries "
                 f"for {len(self.optimizer_name)} optimizers; pass one dict "
                 f"or one per optimizer"
             )
-        okw = self.optimizer_kwargs[optimizer_index % len(self.optimizer_kwargs)]
-        if okw is not None:
-            optimizer_kwargs.update(okw)
+        idx = next(self.optimizer_iter)
+        merged = dict(self.optimizer_kwargs[idx % len(self.optimizer_kwargs)] or {})
         if self.distance_metric is not None:
-            optimizer_kwargs["distance_metric"] = self.distance_metric
+            merged["distance_metric"] = self.distance_metric
+        return self.optimizer_name[idx], merged
 
+    def _epoch_spec(self, optimizer_name, optimizer_kwargs):
+        """Keyword spec for one `moasmo.epoch` call over the current
+        archive; the names are `moasmo.epoch`'s own signature."""
+        plumbed = (
+            "surrogate_method_name", "surrogate_method_kwargs",
+            "surrogate_custom_training", "surrogate_custom_training_kwargs",
+            "sensitivity_method_name", "sensitivity_method_kwargs",
+            "feasibility_method_name", "feasibility_method_kwargs",
+            "optimize_mean_variance", "termination", "local_random",
+            "logger", "file_path", "mesh",
+        )
+        spec = {name: getattr(self, name) for name in plumbed}
+        spec.update(
+            pop=self.population_size,
+            optimizer_name=optimizer_name,
+            optimizer_kwargs=optimizer_kwargs,
+        )
+        return spec
+
+    def initialize_epoch(self, epoch_index: int):
+        assert self.opt_gen is None, (
+            "Optimization generator is active in DistOptStrategy"
+        )
+        name, okw = self._cycled_optimizer()
         self._update_evals()
 
         assert epoch_index > self.epoch_index
@@ -342,23 +351,7 @@ class DistOptStrategy:
             self.x,
             self.y,
             self.c,
-            pop=self.population_size,
-            optimizer_name=self.optimizer_name[optimizer_index],
-            optimizer_kwargs=optimizer_kwargs,
-            surrogate_method_name=self.surrogate_method_name,
-            surrogate_method_kwargs=self.surrogate_method_kwargs,
-            surrogate_custom_training=self.surrogate_custom_training,
-            surrogate_custom_training_kwargs=self.surrogate_custom_training_kwargs,
-            sensitivity_method_name=self.sensitivity_method_name,
-            sensitivity_method_kwargs=self.sensitivity_method_kwargs,
-            feasibility_method_name=self.feasibility_method_name,
-            feasibility_method_kwargs=self.feasibility_method_kwargs,
-            optimize_mean_variance=self.optimize_mean_variance,
-            termination=self.termination,
-            local_random=self.local_random,
-            logger=self.logger,
-            file_path=self.file_path,
-            mesh=self.mesh,
+            **self._epoch_spec(name, okw),
         )
 
         item = None
