@@ -104,7 +104,7 @@ class GPParams(NamedTuple):
 class GPFit(NamedTuple):
     """Posterior state for a batch of d independent GPs (pytree)."""
 
-    X: jax.Array  # (N, n) unit-box inputs
+    X: jax.Array  # (N, n) unit-box inputs (possibly bucket-padded)
     L: jax.Array  # (d, N, N) Cholesky of K + noise*I
     alpha: jax.Array  # (d, N)  (K + noise I)^-1 y_std
     amp: jax.Array  # (d,)
@@ -113,6 +113,7 @@ class GPFit(NamedTuple):
     y_mean: jax.Array  # (d,)
     y_std: jax.Array  # (d,)
     nmll: jax.Array  # (d,) final negative log marginal likelihood
+    train_mask: jax.Array  # (N,) 1 = real training row, 0 = bucket padding
 
 
 def _default_rel_jitter(dtype) -> float:
@@ -138,14 +139,30 @@ def _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter=None):
     return K + (noise + jitter) * jnp.eye(N, dtype=X.dtype)
 
 
-def _nmll(params: GPParams, bounds3, X, y, kernel_fn, rel_jitter):
-    """Exact negative log marginal likelihood (per objective)."""
+def _apply_train_mask(K, train_mask):
+    """Decouple padded rows from the GP exactly: K_m = (m mᵀ)∘K + diag(1−m).
+    With padded targets zeroed, the padded block is an identity whose
+    quadratic term and log-determinant are both zero, so the masked MLL,
+    posterior alpha, and (with masked cross-covariances) predictions equal
+    the unpadded ones in exact arithmetic (f32 reduction order differs) —
+    padding only buys a static shape."""
+    if train_mask is None:
+        return K
+    m = train_mask.astype(K.dtype)
+    return (m[:, None] * m[None, :]) * K + jnp.diag(1.0 - m)
+
+
+def _nmll(params: GPParams, bounds3, X, y, kernel_fn, rel_jitter, train_mask=None):
+    """Exact negative log marginal likelihood (per objective); `y` must
+    already be zeroed on padded rows when `train_mask` is given."""
     b_amp, b_ls, b_noise = bounds3
     amp = b_amp.forward(params.u_amp)
     ls = b_ls.forward(params.u_ls)
     noise = b_noise.forward(params.u_noise)
-    N = X.shape[0]
-    K = _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter)
+    N = X.shape[0] if train_mask is None else jnp.sum(train_mask)
+    K = _apply_train_mask(
+        _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter), train_mask
+    )
     L = jnp.linalg.cholesky(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), y)
     return (
@@ -180,14 +197,19 @@ def fit_gp_batch(
     learning_rate: float = 0.1,
     ard: bool = False,
     rel_jitter: Optional[float] = None,
+    train_mask: Optional[jax.Array] = None,
 ) -> GPFit:
     """Fit d independent GPs with S random restarts each, as one program.
 
     The (S, d) grid of NMLLs shares a single batched Cholesky per Adam step;
     the best restart per objective wins (replaces SCE-UA global search,
-    reference model.py:1419-1753).
+    reference model.py:1419-1753). `train_mask` (N,) marks real rows when X/Y
+    are bucket-padded to a static shape (see `_pad_to_bucket`); masked fits
+    are exactly the unpadded fits.
     """
     N, n = X.shape
+    if train_mask is not None:
+        Y = Y * train_mask[:, None].astype(Y.dtype)
     d = Y.shape[1]
     Lls = n if ard else 1
     dt = X.dtype
@@ -218,7 +240,7 @@ def fit_gp_batch(
 
     # loss over the (S, d) grid: vmap over restarts, then objectives.
     def loss_one(p, y):
-        return _nmll(p, bounds3, X, y, kernel_fn, rel_jitter)
+        return _nmll(p, bounds3, X, y, kernel_fn, rel_jitter, train_mask)
 
     def loss_grid(params):
         per_obj = jax.vmap(loss_one, in_axes=(0, 1))  # over objectives
@@ -258,7 +280,10 @@ def fit_gp_batch(
     noise = b_noise.forward(take(params.u_noise))
 
     def posterior(amp_i, ls_i, noise_i, y):
-        K = _regularized_kernel(X, ls_i, amp_i, noise_i, kernel_fn, rel_jitter)
+        K = _apply_train_mask(
+            _regularized_kernel(X, ls_i, amp_i, noise_i, kernel_fn, rel_jitter),
+            train_mask,
+        )
         L = jnp.linalg.cholesky(K)
         alpha = jax.scipy.linalg.cho_solve((L, True), y)
         return L, alpha
@@ -266,8 +291,10 @@ def fit_gp_batch(
     L, alpha = jax.vmap(posterior, in_axes=(0, 0, 0, 1))(amp, ls, noise, Y)
     nmll = jnp.min(final, axis=0)
     zeros = jnp.zeros((d,), dt)
+    tm = jnp.ones((N,), dt) if train_mask is None else train_mask.astype(dt)
     return GPFit(X=X, L=L, alpha=alpha, amp=amp, ls=ls, noise=noise,
-                 y_mean=zeros, y_std=jnp.ones((d,), dt), nmll=nmll)
+                 y_mean=zeros, y_std=jnp.ones((d,), dt), nmll=nmll,
+                 train_mask=tm)
 
 
 @partial(jax.jit, static_argnames=("kernel", "n_starts", "n_iter", "rel_jitter"))
@@ -283,12 +310,15 @@ def fit_gp_shared(
     n_iter: int = 300,
     learning_rate: float = 0.1,
     rel_jitter: Optional[float] = None,
+    train_mask: Optional[jax.Array] = None,
 ) -> GPFit:
     """Joint multi-output fit: ONE shared ARD kernel for all d objectives,
     optimized on the summed exact MLL (the statistical coupling of the
     reference's multitask GP, model_gpytorch.py:1623-1926, without its
     Kronecker task covariance). Posterior stays per-objective."""
     N, n = X.shape
+    if train_mask is not None:
+        Y = Y * train_mask[:, None].astype(Y.dtype)
     d = Y.shape[1]
     dt = X.dtype
     if rel_jitter is None:
@@ -317,13 +347,17 @@ def fit_gp_shared(
         amp = b_amp.forward(p.u_amp)
         ls = b_ls.forward(p.u_ls)
         noise = b_noise.forward(p.u_noise)
-        K = _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter)
+        K = _apply_train_mask(
+            _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter),
+            train_mask,
+        )
         L = jnp.linalg.cholesky(K)
         alpha = jax.scipy.linalg.cho_solve((L, True), Y)  # (N, d)
+        N_eff = N if train_mask is None else jnp.sum(train_mask)
         return (
             0.5 * jnp.sum(Y * alpha)
             + d * jnp.sum(jnp.log(jnp.diagonal(L)))
-            + 0.5 * d * N * _LOG2PI
+            + 0.5 * d * N_eff * _LOG2PI
         )
 
     def total_loss(params):
@@ -355,7 +389,9 @@ def fit_gp_shared(
     ls = b_ls.forward(params.u_ls[best])
     noise = b_noise.forward(params.u_noise[best])
 
-    K = _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter)
+    K = _apply_train_mask(
+        _regularized_kernel(X, ls, amp, noise, kernel_fn, rel_jitter), train_mask
+    )
     L = jnp.linalg.cholesky(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), Y)  # (N, d)
     return GPFit(
@@ -368,6 +404,9 @@ def fit_gp_shared(
         y_mean=jnp.zeros((d,), dt),
         y_std=jnp.ones((d,), dt),
         nmll=jnp.broadcast_to(vals[best] / d, (d,)),
+        train_mask=(
+            jnp.ones((N,), dt) if train_mask is None else train_mask.astype(dt)
+        ),
     )
 
 
@@ -383,6 +422,9 @@ def gp_predict(fit: GPFit, Xq: jax.Array, kernel: str = "matern52"):
 
     def one(L, alpha, amp, ls, noise, ym, ys):
         Ks = kernel_fn(fit.X, Xq, ls, amp)  # (N, M)
+        # padded training rows carry no information: zero their cross-
+        # covariance so the posterior equals the unpadded one exactly
+        Ks = Ks * fit.train_mask[:, None].astype(Ks.dtype)
         mean = Ks.T @ alpha
         v = jax.scipy.linalg.solve_triangular(L, Ks, lower=True)  # (N, M)
         var = amp + noise - jnp.sum(v * v, axis=0)
@@ -424,6 +466,32 @@ def _prepare_training_data(model, xin, yin, nInput, nOutput, xlb, xub, nan, top_
     y_std = np.where(y_std == 0.0, 1.0, y_std)
     Yn = (yin - y_mean) / y_std
     return X, Yn, y_mean, y_std
+
+
+def _bucket_size(N: int) -> int:
+    """Static-shape bucket for a training-set size: multiples of 64 up to
+    512, multiples of 256 beyond. MO-ASMO grows the training set every
+    epoch (reference MOASMO.py:473-530 refits per epoch); bucketing keeps
+    the fit/predict programs' shapes stable across epochs so XLA compiles
+    once per bucket instead of once per epoch, at ≤(1+b/N)³ extra Cholesky
+    FLOPs — negligible at the sizes where FLOPs matter."""
+    step = 64 if N <= 512 else 256
+    return max(step, step * -(-N // step))
+
+
+def _pad_to_bucket(X: np.ndarray, Yn: np.ndarray):
+    """Pad (X, Y) rows up to `_bucket_size` and return (X_pad, Y_pad, mask).
+    Padded x rows sit at the unit-box center (any finite value works: the
+    train mask decouples them exactly — see `_apply_train_mask`)."""
+    N = X.shape[0]
+    cap = _bucket_size(N)
+    if cap == N:
+        return X, Yn, np.ones((N,), dtype=X.dtype)
+    pad = cap - N
+    X_pad = np.concatenate([X, np.full((pad, X.shape[1]), 0.5, X.dtype)])
+    Y_pad = np.concatenate([Yn, np.zeros((pad, Yn.shape[1]), Yn.dtype)])
+    mask = np.concatenate([np.ones((N,), X.dtype), np.zeros((pad,), X.dtype)])
+    return X_pad, Y_pad, mask
 
 
 def _resolve_dtype(dtype):
@@ -512,10 +580,12 @@ class GPR_Matern(SurrogateMixin):
         if anisotropic is None:
             anisotropic = self.anisotropic_default
         key = as_key(seed)
+        X, Yn, tmask = _pad_to_bucket(X, Yn)
         fit = fit_gp_batch(
             key,
             jnp.asarray(X, dt),
             jnp.asarray(Yn, dt),
+            train_mask=jnp.asarray(tmask, dt),
             lengthscale_bounds=tuple(length_scale_bounds),
             amplitude_bounds=tuple(constant_kernel_bounds),
             noise_bounds=tuple(noise_level_bounds),
@@ -597,10 +667,12 @@ class MEGP_Matern(SurrogateMixin):
             self, xin, yin, nInput, nOutput, xlb, xub, nan, top_k
         )
 
+        X, Yn, tmask = _pad_to_bucket(X, Yn)
         fit = fit_gp_shared(
             as_key(seed),
             jnp.asarray(X, jnp.float32),
             jnp.asarray(Yn, jnp.float32),
+            train_mask=jnp.asarray(tmask, jnp.float32),
             lengthscale_bounds=tuple(length_scale_bounds),
             amplitude_bounds=tuple(constant_kernel_bounds),
             noise_bounds=tuple(noise_level_bounds),

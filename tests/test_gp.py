@@ -35,6 +35,39 @@ def test_gp_interpolates_training_data(cls):
     assert mae < 0.2, mae
 
 
+def test_bucket_padding_is_exact():
+    """A bucket-padded masked fit must match the unpadded fit: identical
+    math (padding decouples exactly), differing only by f32 reduction-order
+    noise accumulated over the Adam trajectory."""
+    import jax.numpy as jnp
+
+    from dmosopt_tpu.models.gp import fit_gp_batch, gp_predict
+    from dmosopt_tpu.utils.prng import as_key
+
+    X, Y = _data(n=40)
+    ym, ys = Y.mean(0), Y.std(0)
+    Yn = (Y - ym) / ys
+    Xq = jnp.asarray(_data(n=12, seed=7)[0], jnp.float32)
+
+    Xj = jnp.asarray(X, jnp.float32)
+    Yj = jnp.asarray(Yn, jnp.float32)
+    fit_plain = fit_gp_batch(as_key(1), Xj, Yj, n_starts=3, n_iter=40)
+
+    pad = 24
+    Xp = jnp.concatenate([Xj, jnp.full((pad, 3), 0.5, jnp.float32)])
+    Yp = jnp.concatenate([Yj, jnp.zeros((pad, 2), jnp.float32)])
+    tm = jnp.concatenate([jnp.ones((40,)), jnp.zeros((pad,))]).astype(jnp.float32)
+    fit_pad = fit_gp_batch(as_key(1), Xp, Yp, n_starts=3, n_iter=40, train_mask=tm)
+
+    np.testing.assert_allclose(fit_plain.amp, fit_pad.amp, rtol=2e-2)
+    np.testing.assert_allclose(fit_plain.ls, fit_pad.ls, rtol=2e-2)
+    np.testing.assert_allclose(fit_plain.nmll, fit_pad.nmll, rtol=2e-2, atol=5e-3)
+    mu0, v0 = gp_predict(fit_plain, Xq)
+    mu1, v1 = gp_predict(fit_pad, Xq)
+    np.testing.assert_allclose(mu0, mu1, rtol=1e-2, atol=5e-3)
+    np.testing.assert_allclose(v0, v1, rtol=2e-2, atol=1e-4)
+
+
 def test_gp_generalizes():
     X, Y = _data(n=80)
     Xt, Yt = _data(n=30, seed=9)
@@ -58,7 +91,9 @@ def test_gp_nan_filtering():
     Y = Y.copy()
     Y[3, 0] = np.nan
     m = GPR_Matern(X, Y, 3, 2, np.zeros(3), np.ones(3), seed=1, nan="remove", **FAST)
-    assert m.fit.X.shape[0] == 39
+    # the NaN row is dropped; remaining rows are bucket-padded to a static
+    # shape with the padding masked out
+    assert int(np.asarray(m.fit.train_mask).sum()) == 39
 
 
 def test_gp_evaluate_mean_variance_flag():
