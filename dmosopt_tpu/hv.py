@@ -386,7 +386,9 @@ def local_upper_bounds(
             nq[-1] = z
             new_ubs.append(nu)
             new_defs.append(nq)
-            # update in dimension j < d-1 only if z_j >= max_{k!=j} z^k_j(u)
+            # update in dimension j < d-1 only if z_j > max_{k!=j} z^k_j(u).
+            # This assumes general position — tied coordinates are broken
+            # upstream by `_break_ties` before the decomposition.
             for j in range(d - 1):
                 other = np.delete(q[:, j], j)
                 if np.max(other) < z[j]:
@@ -408,6 +410,51 @@ def local_upper_bounds(
     return np.asarray(ubs), np.asarray(defs)
 
 
+def _break_ties(front: np.ndarray, ref_point: np.ndarray):
+    """Simulation-of-simplicity for the box decomposition: tied
+    coordinates make the local-upper-bound update drop needed bounds (the
+    algorithm assumes general position), silently losing volume.
+
+    Works in RANK space: each dimension's coordinates are replaced by
+    their dense rank (exact small integers), with ties split by
+    ``rank + i/(n+2)`` — immune to floating-point spacing, unlike value
+    perturbation, which silently fails when a column's values are within
+    a few ulps. The decomposition only ever copies coordinates (no
+    arithmetic on them), so ``unmap`` restores the ORIGINAL values on box
+    corners exactly and the final volumes are exact, not epsilon-shifted.
+    Any consistent tie-break yields a valid partition in the
+    zero-perturbation limit. Returns (front_t, ref_t, unmap)."""
+    front = np.asarray(front, dtype=np.float64)
+    n, d = front.shape
+    front_t = np.empty_like(front)
+    ref_t = np.empty(d)
+    maps = []
+    for j in range(d):
+        col = front[:, j]
+        vals = np.unique(np.append(col, ref_point[j]))  # sorted, distinct
+        rank = {v: float(i) for i, v in enumerate(vals)}
+        back = {}
+        new = np.empty(n)
+        for v in np.unique(col):
+            ties = np.flatnonzero(col == v)
+            for i, idx in enumerate(ties):
+                tv = rank[v] + i / (n + 2)
+                new[idx] = tv
+                back[tv] = v
+        front_t[:, j] = new
+        ref_t[j] = rank[ref_point[j]]
+        back[ref_t[j]] = ref_point[j]
+        maps.append(back)
+
+    def unmap(arr):
+        out = np.array(arr, copy=True)
+        for j, back in enumerate(maps):
+            out[:, j] = [back.get(v, v) for v in out[:, j]]
+        return out
+
+    return front_t, ref_t, unmap
+
+
 def dominated_boxes(
     front: np.ndarray, ref_point: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -419,15 +466,24 @@ def dominated_boxes(
     ref_point = np.asarray(ref_point, dtype=np.float64)
     if front.shape[0] == 0:
         return np.zeros((0, len(ref_point))), np.zeros((0, len(ref_point)))
-    ubs, defs = local_upper_bounds(front, ref_point)
+    unmap = None
+    for j in range(front.shape[1]):
+        if np.unique(front[:, j]).size < front.shape[0]:
+            front, ref_lub, unmap = _break_ties(front, ref_point)
+            break
+    else:
+        ref_lub = ref_point
+    ubs, defs = local_upper_bounds(front, ref_lub)
     M, d = ubs.shape
     lowers = np.empty((M, d))
     uppers = np.empty((M, d))
     lowers[:, 0] = defs[:, 0, 0]  # z^1_1(u)
-    uppers[:, 0] = ref_point[0]
+    uppers[:, 0] = ref_lub[0]  # in tie-broken rank space until unmapped
     for j in range(1, d):
         lowers[:, j] = np.max(defs[:, :j, j], axis=1)  # max_{k<j} z^k_j(u)
         uppers[:, j] = ubs[:, j]
+    if unmap is not None:
+        lowers, uppers = unmap(lowers), unmap(uppers)
     valid = np.all(uppers > lowers, axis=1) & np.all(np.isfinite(lowers), axis=1)
     return lowers[valid], uppers[valid]
 

@@ -99,6 +99,41 @@ def test_box_decomposition_matches_wfg_oracle():
         assert got == pytest.approx(want, rel=1e-9), (d, got, want)
 
 
+def test_box_decomposition_exact_with_tied_coordinates():
+    """Regression: tied coordinates (ubiquitous on real archives — points
+    sharing an objective value, integer-grid fronts) used to make the
+    local-upper-bound update drop needed bounds and silently undercount
+    HV; a growing archive could then show decreasing hypervolume."""
+    rng = np.random.default_rng(11)
+    # the originally observed shape: two points tied in objective 0
+    front = np.array(
+        [[0.0, 0.49153617, 16.42065],
+         [0.0, 0.571942, 15.836044],
+         [0.61845076, 0.96437263, 12.834977]]
+    )
+    ref = np.array([1.09375, 1.09375, 25.613188])
+    got = hv.hypervolume_exact(front, ref)
+    want = hv._hypervolume_wfg(front.copy(), ref)
+    assert got == pytest.approx(want, rel=1e-12), (got, want)
+
+    # integer-grid torture: every coordinate tied many times over
+    for d in (3, 4):
+        for _ in range(20):
+            pts = rng.integers(0, 4, size=(8, d)) / 4.0
+            ref = np.ones(d)
+            got = hv.hypervolume_exact(pts, ref)
+            want = hv._hypervolume_wfg(pts.copy(), ref)
+            assert got == pytest.approx(want, abs=1e-12), (d, got, want)
+
+    # monotonicity: HV of a superset never decreases (fixed ref)
+    base = rng.random((12, 3))
+    extra = rng.random((6, 3))
+    ref = np.ones(3)
+    hv_base = hv.hypervolume_exact(base, ref)
+    hv_all = hv.hypervolume_exact(np.vstack([base, extra]), ref)
+    assert hv_all >= hv_base - 1e-12
+
+
 def test_dominated_boxes_partition_volume_2d():
     # in 2-D the box-decomposition volume must equal the staircase sweep
     rng = np.random.default_rng(6)
