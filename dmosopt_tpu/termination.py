@@ -23,11 +23,16 @@ class Termination:
     def __init__(self, problem) -> None:
         self.problem = problem
         self.force_termination = False
+        self.stopped = False  # set once this criterion fires
 
     def do_continue(self, opt):
         if self.force_termination:
+            self.stopped = True
             return False
-        return self._do_continue(opt)
+        cont = self._do_continue(opt)
+        if not cont:
+            self.stopped = True
+        return cont
 
     def _do_continue(self, opt, **kwargs):  # pragma: no cover
         return True
@@ -47,6 +52,10 @@ class Termination:
         check-interval granularity."""
         return None
 
+    def stop_reasons(self):
+        """Names of the criteria that actually fired (diagnostics)."""
+        return [type(self).__name__] if self.stopped else []
+
 
 class TerminationCollection(Termination):
     """Terminate when ANY member terminates (reference termination.py:61-69)."""
@@ -63,6 +72,9 @@ class TerminationCollection(Termination):
             b for b in (t.eval_budget() for t in self.terminations) if b is not None
         ]
         return min(budgets) if budgets else None
+
+    def stop_reasons(self):
+        return [r for t in self.terminations for r in t.stop_reasons()]
 
 
 class MaximumGenerationTermination(Termination):
