@@ -1,0 +1,51 @@
+"""Minimal controller-only stand-in for the distwq MPI work queue, for
+benchmarking the reference dmosopt single-process (its own degenerate
+no-workers mode): tasks submitted via submit_multiple are evaluated
+inline and returned by probe_all_next_results."""
+
+import importlib
+import time
+
+
+class MPIController:
+    def __init__(self, time_limit=None):
+        self.time_limit = time_limit
+        self.start_time = time.time()
+        self.workers_available = False
+        self._results = []
+        self._next_id = 0
+        self.stats = []
+        self.n_processed = {}
+        self.total_time = {}
+        self.total_time_est = {}
+
+    def process(self):
+        pass
+
+    def submit_multiple(self, name, module_name=None, args=()):
+        mod = importlib.import_module(module_name)
+        fn = getattr(mod, name)
+        ids = []
+        for a in args:
+            tid = self._next_id
+            self._next_id += 1
+            self._results.append((tid, fn(*a)))
+            ids.append(tid)
+        return ids
+
+    def probe_all_next_results(self):
+        out = self._results
+        self._results = []
+        return out
+
+
+is_controller = True
+is_worker = True
+workers_available = False
+
+
+def run(fun_name=None, module_name=None, verbose=False, args=(),
+        time_limit=None, **kwargs):
+    mod = importlib.import_module(module_name)
+    fn = getattr(mod, fun_name)
+    return fn(MPIController(time_limit=time_limit), *args)

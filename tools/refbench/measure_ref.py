@@ -1,0 +1,91 @@
+"""Measure the reference dmosopt on CPU for BASELINE configs 2-4.
+
+Methodology: single-process (controller-only distwq stub), identical
+configs to bench.py's TPU runs. GP-fit seconds are accumulated by
+wrapping MOASMO.train; objective-eval seconds come from the strategy's
+own eval_sum stat; inner-EA gens/sec = generations / (wall - fit - eval).
+"""
+import json, sys, time
+import numpy as np
+import os as _os
+OUT_DIR = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), 'results')
+import logging
+logging.basicConfig(level=logging.ERROR)
+
+import dmosopt.MOASMO as MO
+from dmosopt import dmosopt as dm
+
+FIT = {"sec": 0.0, "n": 0}
+_train = MO.train
+def train_timed(*a, **k):
+    t0 = time.perf_counter()
+    out = _train(*a, **k)
+    FIT["sec"] += time.perf_counter() - t0
+    FIT["n"] += 1
+    return out
+MO.train = train_timed
+
+def run_cfg(name, params, time_limit=None):
+    FIT["sec"] = 0.0; FIT["n"] = 0
+    t0 = time.perf_counter()
+    best = dm.run(dict(params), time_limit=time_limit, verbose=False)
+    wall = time.perf_counter() - t0
+    dopt = dm.dopt_dict[params["opt_id"]]
+    strat = dopt.optimizer_dict[0]
+    eval_sum = float(strat.stats.get("eval_sum", 0.0))
+    n_evals = 0 if strat.x is None else int(strat.x.shape[0])
+    # total surrogate-EA generations actually run
+    gens = params["num_generations"] * max(dopt.epoch_count, 1)
+    ea_sec = max(wall - FIT["sec"] - eval_sum, 1e-9)
+    out = {
+        "config": name, "wall_sec": round(wall, 2),
+        "gp_fit_sec_total": round(FIT["sec"], 2), "gp_fits": FIT["n"],
+        "eval_sec_total": round(eval_sum, 2), "n_evals": n_evals,
+        "gens_total": gens, "ea_gens_per_sec": round(gens / ea_sec, 2),
+        "epochs_run": dopt.epoch_count,
+    }
+    ys = None if strat.y is None else np.asarray(strat.y)
+    return out, ys
+
+results = {}
+arch = {}
+
+base = dict(problem_parameters={}, n_initial=8, n_epochs=5,
+            population_size=100, num_generations=100, resample_fraction=0.25,
+            optimizer_name="age", surrogate_method_name="gpr", random_seed=42)
+
+for prob in ("zdt1", "zdt2", "zdt3"):
+    p = dict(base, opt_id=f"{prob}_age", obj_fun_name=f"ref_objectives.{prob}_obj",
+             objective_names=["f1", "f2"],
+             space={f"x{i}": [0.0, 1.0] for i in range(30)})
+    r, y = run_cfg(f"{prob}_agemoea_gpr", p, time_limit=420)
+    print(json.dumps(r), flush=True)
+    results[r["config"]] = r; arch[r["config"]] = y
+
+# TNK constrained (dim=2), feasibility path
+p = dict(base, opt_id="tnk", obj_fun_name="ref_objectives.tnk_obj_with_constraints",
+         objective_names=["f1", "f2"], constraint_names=["c1", "c2"],
+         space={"x1": [1e-12, np.pi], "x2": [1e-12, np.pi]},
+         feasibility_model=True)
+r, y = run_cfg("tnk_constrained", p, time_limit=420)
+print(json.dumps(r), flush=True)
+results[r["config"]] = r; arch[r["config"]] = y
+
+# DTLZ2/DTLZ7 5-obj dim=100 with adaptive termination (HV progress)
+for prob, fn in (("dtlz2", "dtlz2_obj_5"), ("dtlz7", "dtlz7_obj_5")):
+    p = dict(base, opt_id=f"{prob}_m5", obj_fun_name=f"ref_objectives.{fn}",
+             objective_names=[f"f{i+1}" for i in range(5)],
+             space={f"x{i}": [0.0, 1.0] for i in range(100)},
+             n_initial=2, n_epochs=2, num_generations=50,
+             termination_conditions=True)
+    r, y = run_cfg(f"{prob}_5obj_dim100", p, time_limit=600)
+    print(json.dumps(r), flush=True)
+    results[r["config"]] = r; arch[r["config"]] = y
+
+import os
+os.makedirs(OUT_DIR, exist_ok=True)
+with open(os.path.join(OUT_DIR, "ref_results.json"), "w") as f:
+    json.dump(results, f, indent=2)
+np.savez(os.path.join(OUT_DIR, "ref_archives.npz"),
+         **{k: v for k, v in arch.items() if v is not None})
+print("DONE")

@@ -1,0 +1,95 @@
+"""Benchmark objectives importable by name for the reference dmosopt."""
+import numpy as np
+
+def _x(pp, n):
+    return np.array([pp[f"x{i}"] for i in range(n)])
+
+def zdt1_obj(pp):
+    x = _x(pp, len(pp)); f1 = x[0]
+    g = 1.0 + 9.0 / (len(x) - 1) * np.sum(x[1:])
+    return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
+
+def zdt2_obj(pp):
+    x = _x(pp, len(pp)); f1 = x[0]
+    g = 1.0 + 9.0 / (len(x) - 1) * np.sum(x[1:])
+    return np.array([f1, g * (1.0 - (f1 / g) ** 2)])
+
+def zdt3_obj(pp):
+    x = _x(pp, len(pp)); f1 = x[0]
+    g = 1.0 + 9.0 / (len(x) - 1) * np.sum(x[1:])
+    h = 1.0 - np.sqrt(f1 / g) - (f1 / g) * np.sin(10 * np.pi * f1)
+    return np.array([f1, g * h])
+
+def tnk_obj(pp):
+    x1, x2 = pp["x1"], pp["x2"]
+    return np.array([x1, x2])
+
+def tnk_constraints(pp):
+    x1, x2 = pp["x1"], pp["x2"]
+    theta = np.arctan2(x2, x1)
+    c1 = x1**2 + x2**2 - 1.0 - 0.1 * np.cos(16.0 * theta)  # >= 0 feasible
+    c2 = 0.5 - (x1 - 0.5) ** 2 - (x2 - 0.5) ** 2            # >= 0 feasible
+    return np.array([c1, c2])
+
+def tnk_obj_with_constraints(pp):
+    return tnk_obj(pp), tnk_constraints(pp)
+
+def dtlz2_obj_5(pp):
+    x = _x(pp, len(pp)); M = 5
+    xm = x[M - 1:]
+    g = np.sum((xm - 0.5) ** 2)
+    f = []
+    for i in range(M):
+        v = 1.0 + g
+        for j in range(M - 1 - i):
+            v *= np.cos(0.5 * np.pi * x[j])
+        if i > 0:
+            v *= np.sin(0.5 * np.pi * x[M - 1 - i])
+        f.append(v)
+    return np.asarray(f)
+
+def dtlz7_obj_5(pp):
+    x = _x(pp, len(pp)); M = 5
+    xm = x[M - 1:]
+    g = 1.0 + 9.0 * np.mean(xm)
+    f = list(x[: M - 1])
+    h = M - np.sum([fi / (1.0 + g) * (1.0 + np.sin(3 * np.pi * fi)) for fi in f])
+    f.append((1.0 + g) * h)
+    return np.asarray(f)
+
+# Lorenz-63 parameter estimation — the EXACT workload bench.py's config-5
+# runs on TPU: 4000 RK4 steps (dt=0.01) from X0=[-0.5,1,0.5], trajectory
+# subsampled [800::10], objectives = (mean |traj - target|, squared
+# parameter prior). The target is hoisted to module level so the
+# reference pays one integration per evaluation, same as ours.
+_LORENZ_X0 = np.array([-0.5, 1.0, 0.5])
+_LORENZ_TRUE = np.array([10.0, 28.0, 8.0 / 3.0])
+_LORENZ_STEPS, _LORENZ_SKIP, _LORENZ_STRIDE, _LORENZ_DT = 4000, 800, 10, 0.01
+
+
+def _lorenz_traj(p):
+    def deriv(s):
+        si, r, b = p
+        x, y, z = s
+        return np.array([si * (y - x), x * (r - z) - y, x * y - b * z])
+
+    dt = _LORENZ_DT
+    s = _LORENZ_X0.copy()
+    out = np.empty((_LORENZ_STEPS, 3))
+    for i in range(_LORENZ_STEPS):
+        k1 = deriv(s); k2 = deriv(s + 0.5 * dt * k1)
+        k3 = deriv(s + 0.5 * dt * k2); k4 = deriv(s + dt * k3)
+        s = s + dt / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
+        out[i] = s
+    return out[_LORENZ_SKIP::_LORENZ_STRIDE]
+
+
+_LORENZ_TARGET = _lorenz_traj(_LORENZ_TRUE)
+
+
+def lorenz_obj(pp):
+    p = np.array([pp["sigma"], pp["rho"], pp["beta"]])
+    traj = _lorenz_traj(p)
+    err = float(np.mean(np.abs(traj - _LORENZ_TARGET)))
+    prior = float(np.sum((p - _LORENZ_TRUE) ** 2))
+    return np.array([err, prior])
