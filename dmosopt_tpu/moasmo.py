@@ -74,6 +74,13 @@ def remove_duplicates(x, y, eps: float = 1e-16):
 
 
 def _as_np(x):
+    """Device -> host. A multi-host (DCN) mesh shards arrays across
+    processes; fetching a value that spans non-addressable devices
+    requires an explicit cross-process all-gather first."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
     return np.asarray(x)
 
 

@@ -1,0 +1,74 @@
+"""Loopback multi-process cluster launcher (testing/validation).
+
+Spawns N python processes that together form a `jax.distributed` CPU
+cluster — each owning `devices_per_process` virtual devices — so
+DCN-spanning meshes can be exercised on one machine (the validation
+analog of the reference's `mpirun -n K` runs, dmosopt.py:2518-2536).
+Shared by tests/test_multihost.py and __graft_entry__.dryrun_multihost.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Tuple
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_loopback_cluster(
+    worker_script: str,
+    n_processes: int = 2,
+    devices_per_process: int = 4,
+    timeout: float = 600.0,
+    extra_args: Tuple[str, ...] = (),
+) -> List[Tuple[int, str]]:
+    """Run `worker_script <coordinator> <n> <pid> [extra...]` in
+    `n_processes` coordinated processes; returns [(returncode, output)].
+    Kills the whole cluster if any rank exceeds `timeout` (a hung
+    collective must not orphan the peers holding the coordinator port).
+    """
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    coordinator = f"127.0.0.1:{free_port()}"
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # concurrent ranks must not share a persistent compilation cache
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={devices_per_process}"
+    ).strip()
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker_script, coordinator,
+             str(n_processes), str(pid), *extra_args],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(n_processes)
+    ]
+    results: List[Tuple[int, str]] = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            out, _ = p.communicate()
+            results.append((p.returncode, f"[TIMEOUT after {timeout}s]\n{out}"))
+    return results

@@ -1,0 +1,80 @@
+"""Worker script for the multi-host (DCN) loopback test: one JAX process
+of a 2-process cluster. Each process owns a set of virtual CPU devices;
+the mesh spans BOTH processes' devices, so the sharded epoch's
+collectives cross the process boundary — the loopback equivalent of a
+DCN-spanning pod (reference capability: `mpirun -n K` + distwq,
+dmosopt.py:2518-2536).
+
+Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id>
+"""
+
+import os
+import sys
+
+
+def main():
+    coordinator, num_procs, proc_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dmosopt_tpu.parallel.mesh import create_mesh, initialize_distributed
+
+    rank = initialize_distributed(
+        coordinator_address=coordinator,
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    assert rank == proc_id, (rank, proc_id)
+    n_global = jax.device_count()
+    n_local = len(jax.local_devices())
+    assert n_global == num_procs * n_local, (n_global, n_local)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dmosopt_tpu import moasmo
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+    from dmosopt_tpu.models import Model
+    from dmosopt_tpu.models.gp import GPR_Matern
+    from dmosopt_tpu.optimizers.nsga2 import NSGA2
+
+    # mesh over ALL global devices: the population axis crosses the
+    # process boundary, so the epoch's collectives ride "DCN"
+    mesh = create_mesh(axis_names=("pop",))
+    assert mesh.devices.size == n_global
+
+    dim, pop = 6, 2 * n_global
+    rng = np.random.default_rng(0)
+    x0 = rng.uniform(size=(pop, dim)).astype(np.float32)
+    y0 = np.asarray(zdt1(jnp.asarray(x0)))
+    sm = GPR_Matern(
+        x0, y0, dim, 2, np.zeros(dim), np.ones(dim),
+        seed=0, n_starts=2, n_iter=10,
+    )
+    opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=None)
+    opt.initialize_strategy(
+        x0, y0, np.stack([np.zeros(dim), np.ones(dim)], 1), random=0
+    )
+
+    gen = moasmo.optimize(
+        2, opt, Model(objective=sm), dim, 2,
+        np.zeros(dim), np.ones(dim),
+        popsize=pop, local_random=1, mesh=mesh,
+    )
+    try:
+        next(gen)
+        raise AssertionError("surrogate-mode optimize must not yield")
+    except StopIteration as ex:
+        res = ex.value
+    assert np.all(np.isfinite(res.best_y))
+    print(f"MULTIHOST_OK rank={rank} global_devices={n_global}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Multi-host (DCN) path: a 2-process jax.distributed loopback cluster
+drives `initialize_distributed` plus a mesh spanning both processes'
+devices through one sharded surrogate epoch (reference capability:
+`mpirun -n K` multi-node runs, dmosopt.py:2518-2536 — here one SPMD
+program over DCN instead of an MPI task farm)."""
+
+import os
+
+import pytest
+
+from dmosopt_tpu.parallel.loopback import launch_loopback_cluster
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_multihost_worker.py")
+
+
+@pytest.mark.slow
+def test_two_process_dcn_loopback():
+    num_procs, devs_per_proc = 2, 4
+    results = launch_loopback_cluster(
+        WORKER, n_processes=num_procs, devices_per_process=devs_per_proc,
+        timeout=600,
+    )
+    for rc, out in results:
+        if rc != 0 and "does not support" in out.lower():
+            pytest.skip(f"multi-process CPU backend unavailable:\n{out[-500:]}")
+        assert rc == 0, out[-3000:]
+        assert "MULTIHOST_OK" in out, out[-3000:]
+        assert f"global_devices={num_procs * devs_per_proc}" in out
