@@ -75,6 +75,33 @@ def _filter_dominated(points: np.ndarray) -> np.ndarray:
     return points[~dominated]
 
 
+@partial(jax.jit, static_argnames=("chunk",))
+def _dominated_mask_chunked(points: jax.Array, chunk: int = 512) -> jax.Array:
+    """(N, d) -> (N,) True where another point dominates it (minimization).
+
+    The host-side `_filter_dominated` materializes the full (N, N, d)
+    comparison cube — ~100·d MB of bools at N=10k, which is why the FPRAS
+    path used to skip pruning above 2048 points and pay O(N) cover scans
+    per sample over dominated archive points (the role of the reference's
+    kd-tree prescreen, hv_adaptive.py:40-263). This runs the same
+    reduction on device in (chunk, N, d) tiles under `lax.map`, bounding
+    memory at ~chunk·N·d bools regardless of N."""
+    N, d = points.shape
+    pad = -N % chunk
+    P = jnp.concatenate(
+        [points, jnp.full((pad, d), jnp.inf, points.dtype)]
+    )
+
+    def body(i):
+        rows = jax.lax.dynamic_slice_in_dim(P, i * chunk, chunk)  # (chunk, d)
+        le = jnp.all(points[None, :, :] <= rows[:, None, :], axis=2)
+        lt = jnp.any(points[None, :, :] < rows[:, None, :], axis=2)
+        return jnp.any(le & lt, axis=1)
+
+    masks = jax.lax.map(body, jnp.arange((N + pad) // chunk))
+    return masks.reshape(-1)[:N]
+
+
 def _hypervolume_wfg(points: np.ndarray, ref_point: np.ndarray) -> float:
     """WFG-style exclusive-volume recursion — an independent exact oracle
     used to cross-check the box decomposition (exponential worst case;
@@ -247,6 +274,7 @@ def hypervolume_fpras(
     batch: int = 8192,
     qmc: bool = True,
     return_info: bool = False,
+    prune: bool = True,
 ):
     """FPRAS-class hypervolume estimator with CI-driven adaptive sampling
     (minimization). Capability match for the reference's adaptive high-d
@@ -275,8 +303,24 @@ def hypervolume_fpras(
     if points.ndim != 2 or points.shape[0] == 0:
         return (0.0, (0.0, 0)) if return_info else 0.0
     points = points[np.all(points < ref, axis=1)]
-    if points.shape[0] <= 2048:
-        points = _filter_dominated(points)
+    if prune:
+        if points.shape[0] <= 2048:
+            points = _filter_dominated(points)
+        else:
+            # archive-scale fronts: masked on-device prune (f32 — the
+            # same working precision as the cover-count scan below, so
+            # this adds no precision loss the estimator doesn't already
+            # have). Every dominated point dropped removes an O(1)-per-
+            # sample term from the cover counts. The input is padded to a
+            # power-of-two bucket (+inf rows dominate nothing and prune
+            # themselves) so a growing archive recompiles O(log N) times,
+            # not once per epoch.
+            n_real = points.shape[0]
+            cap = 1 << (n_real - 1).bit_length()
+            padded = np.full((cap, points.shape[1]), np.inf, np.float32)
+            padded[:n_real] = points
+            mask = np.asarray(_dominated_mask_chunked(jnp.asarray(padded)))
+            points = points[~mask[:n_real]]
     n, d = points.shape
     if n == 0:
         return (0.0, (0.0, 0)) if return_info else 0.0

@@ -256,6 +256,58 @@ def test_population_diversity_and_sliding_window():
 # ------------------------------------------------- adaptive FPRAS estimator
 
 
+def test_on_device_dominance_prune_matches_host():
+    """The chunked on-device dominated mask equals the host O(N^2 d)
+    filter, including at sizes that cross the chunk boundary."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    for n in (7, 512, 1300):
+        pts = rng.random((n, 6)).astype(np.float32)
+        mask = np.asarray(hv._dominated_mask_chunked(jnp.asarray(pts)))
+        kept = pts[~mask]
+        want = hv._filter_dominated(pts)
+        assert kept.shape == want.shape, (n, kept.shape, want.shape)
+        assert np.allclose(np.sort(kept, axis=0), np.sort(want, axis=0))
+
+
+@pytest.mark.slow
+def test_fpras_large_archive_prune_speed_and_agreement():
+    """Archive-scale FPRAS (N=10k, mostly dominated points): the
+    on-device prune must (a) leave the estimate within the joint CI of
+    the pruned-front run, and (b) be measurably cheaper per sample than
+    the unpruned cover scan (VERDICT r2 item 8 done-criterion; the role
+    of the reference's kd-tree prescreen, hv_adaptive.py:40-263)."""
+    import time
+
+    import jax
+
+    from dmosopt_tpu.hv import hypervolume_fpras
+
+    rng = np.random.default_rng(0)
+    d = 8
+    pts = rng.random((10_000, d))
+    ref = np.ones(d)
+
+    def run(prune):
+        t0 = time.time()
+        est, (ci, ns) = hypervolume_fpras(
+            pts, ref, epsilon=0.01, key=jax.random.PRNGKey(1),
+            return_info=True, prune=prune,
+        )
+        return est, ci, ns, time.time() - t0
+
+    # first calls pay XLA compiles for both paths; time the warm calls
+    run(True), run(False)
+    est_p, ci_p, ns_p, t_pruned = run(True)
+    est_u, ci_u, ns_u, t_unpruned = run(False)
+
+    assert abs(est_p - est_u) <= ci_p + ci_u, (est_p, est_u, ci_p, ci_u)
+    # pruning shrinks the box set from 10k to the front (~hundreds), so
+    # the per-sample cover scan over box chunks collapses
+    assert t_pruned < t_unpruned, (t_pruned, t_unpruned)
+
+
 def test_fpras_matches_exact_high_dim():
     """CI-target-driven FPRAS agrees with the exact oracle at d=10,15
     within the requested epsilon (VERDICT r1 item 5 done-criterion)."""
