@@ -12,7 +12,7 @@ pytree so the generation loop scans (``jit_compatible = True``; the
 reference loops per generation on the host):
 
 - survival selection is the masked on-device front fill of
-  `ehvi_select.front_fill_selection`;
+  `survival.front_fill_selection`;
 - the Sobol perturbations come from the in-graph generator
   (`sampling.sobol_block`: direction numbers are a state constant, a
   fresh random digital shift per generation replaces re-scrambling);
@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from dmosopt_tpu.optimizers.base import MOEA
-from dmosopt_tpu.optimizers.ehvi_select import front_fill_selection
+from dmosopt_tpu.optimizers.survival import front_fill_selection
 from dmosopt_tpu.ops import non_dominated_rank
 from dmosopt_tpu.sampling import sobol_block, sobol_direction_numbers
 

@@ -67,8 +67,11 @@ def test_cmaes_improves_and_is_scannable():
     d1 = _mean_dist(st.parents_y)
     assert d1 < d0, (d0, d1)
     assert st.parents_x.shape == (POP, DIM)
-    # sigma adaptation happened
-    assert not np.allclose(np.asarray(st.sigmas), np.asarray(st.sigmas)[0, 0])
+    # sigma adaptation happened: step sizes grew from the tiny init
+    # (they may saturate uniformly at the sigma_max_frac cap)
+    assert float(np.mean(np.asarray(st.sigmas))) > 10 * float(
+        np.mean(np.asarray(opt.state.sigmas))
+    )
 
 
 def test_trs_improves_and_adapts_region():
@@ -86,6 +89,46 @@ def test_trs_improves_and_adapts_region():
         <= float(st.tr_length)
         <= opt.opt_params.length_max
     )
+
+
+@pytest.mark.slow
+def test_cmaes_trs_solution_quality_oracles():
+    """Per-optimizer solution-quality oracles on ZDT1 and DTLZ2 (VERDICT
+    r2 item 6): direct 250-generation loops against the true objective,
+    same initial design as the reference head-to-head measurement in
+    BASELINE.md. Bars are set at/below the measured reference quality
+    (its unit-variance-EHVI selection), so passing means the crowding
+    tie-break is equivalence-or-better on these oracles."""
+    from dmosopt_tpu.benchmarks.moo_benchmarks import dtlz2
+
+    pop, ngen = 200, 250
+    # (problem, dim, nobj, objective, distance fn, median bar, within-.05 bar)
+    # reference medians: zdt1 cmaes 0.174, trs 2.871; dtlz2 cmaes 2.217,
+    # trs 0.688 (tools/refbench comparison, 2026-07-30)
+    front = zdt1_pareto(1000)
+    cases = [
+        ("cmaes", CMAES, "zdt1", 30, 2, zdt1,
+         lambda y: np.min(np.linalg.norm(y[:, None] - front[None], axis=2), axis=1),
+         0.175, 5),
+        ("trs", TRS, "zdt1", 30, 2, zdt1,
+         lambda y: np.min(np.linalg.norm(y[:, None] - front[None], axis=2), axis=1),
+         0.5, 0),
+        ("cmaes", CMAES, "dtlz2", 12, 3, lambda X: dtlz2(X, n_obj=3),
+         lambda y: np.abs(np.linalg.norm(y, axis=1) - 1.0), 0.2, 20),
+        ("trs", TRS, "dtlz2", 12, 3, lambda X: dtlz2(X, n_obj=3),
+         lambda y: np.abs(np.linalg.norm(y, axis=1) - 1.0), 0.05, 100),
+    ]
+    for name, cls, prob, dim, nobj, obj, dist, med_bar, within_bar in cases:
+        x0 = sampling.lh(pop, dim, 21).astype(np.float32)
+        y0 = np.asarray(obj(jnp.asarray(x0)))
+        opt = cls(popsize=pop, nInput=dim, nOutput=nobj, model=None)
+        bounds = np.stack([np.zeros(dim), np.ones(dim)], 1)
+        opt.initialize_strategy(x0, y0, bounds, random=21)
+        st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(21), ngen, obj)
+        y = np.asarray(st.parents_y if name == "cmaes" else st.population_obj)
+        d = dist(y.reshape(-1, nobj))
+        assert np.median(d) < med_bar, (name, prob, float(np.median(d)))
+        assert (d <= 0.05).sum() >= within_bar, (name, prob, int((d <= 0.05).sum()))
 
 
 def test_cmaes_host_api_matches_scan_contract():
