@@ -12,6 +12,7 @@ same intent against the dmosopt_tpu HDF5 schema.
 from __future__ import annotations
 
 import json
+import logging
 from collections import OrderedDict
 
 import click
@@ -137,15 +138,19 @@ def train(file_path, opt_id, problem_id, surrogate_method, surrogate_kwargs,
     x, y, f, c, _ = _stack_evals(entries)
     space = raw["parameter_space"]
 
+    logger = logging.getLogger(f"train.{opt_id}")
     sm = moasmo.train(
         x.shape[1], y.shape[1], space.bound1, space.bound2, x, y, c,
         surrogate_method_name=surrogate_method,
         surrogate_method_kwargs=json.loads(surrogate_kwargs),
+        logger=logger,
     )
     import joblib
 
     joblib.dump(sm, output_file)
-    click.echo(f"trained {surrogate_method} surrogate on {x.shape[0]} evals "
+    # name the class actually fitted — large training sets reroute
+    # dense-kernel surrogates to the sparse family (moasmo._route_large_n)
+    click.echo(f"trained {type(sm).__name__} surrogate on {x.shape[0]} evals "
                f"-> {output_file}")
 
 

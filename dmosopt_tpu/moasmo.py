@@ -22,6 +22,7 @@ level* (cheap, runs once per epoch); everything inside is jitted.
 
 from __future__ import annotations
 
+import inspect
 import itertools
 import time
 from typing import Any, Dict, Optional, Tuple
@@ -454,6 +455,38 @@ def xinit(
 
 # -------------------------------------------------------------------- train
 
+# Surrogates that build a dense (N, N) training kernel. Past
+# ``LARGE_N_THRESHOLD`` training points the cubic solve and quadratic
+# memory stop paying on any backend (a 10k-point f32 kernel is 400 MB
+# *per multi-start*), so ``train`` reroutes these registry names to the
+# sparse variational family, whose cost is governed by the inducing-set
+# size instead of N. The reference instead chunks its dense kernel
+# products under memory pressure (model_gpytorch.py:53-100,2071-2079);
+# rerouting to SVGP is the TPU-native equivalent: one static-shape
+# minibatched program instead of data-dependent partitioning.
+_DENSE_KERNEL_SURROGATES = {"gpr", "egp", "megp", "mdgp", "mdspp", "vgp"}
+LARGE_N_THRESHOLD = 4096
+
+
+def _route_large_n(surrogate_method_name, n_train, threshold, logger=None):
+    """Reroute dense-kernel surrogate names to ``svgp`` when the training
+    set exceeds ``threshold`` points. Only registry names are rerouted —
+    a user-supplied import path is always honored as given. ``threshold``
+    of None or 0 disables routing."""
+    if (
+        threshold
+        and surrogate_method_name in _DENSE_KERNEL_SURROGATES
+        and n_train > threshold
+    ):
+        if logger is not None:
+            logger.info(
+                f"train: N={n_train} exceeds the dense-kernel threshold "
+                f"({threshold}); routing surrogate "
+                f"'{surrogate_method_name}' -> 'svgp'"
+            )
+        return "svgp"
+    return surrogate_method_name
+
 
 def train(
     nInput: int,
@@ -470,7 +503,13 @@ def train(
     file_path=None,
 ):
     """Fit the objective surrogate on feasible, deduplicated data
-    (reference: dmosopt/MOASMO.py:473-532)."""
+    (reference: dmosopt/MOASMO.py:473-532).
+
+    Dense-kernel surrogate names (gpr/egp/megp/mdgp/mdspp, plus vgp
+    whose inducing set is the full training set) are rerouted
+    to ``svgp`` once the deduplicated training set exceeds
+    ``surrogate_method_kwargs["large_n_threshold"]`` (default
+    ``LARGE_N_THRESHOLD``; None/0 disables) — see ``_route_large_n``."""
     x = np.asarray(Xinit).copy()
     y = np.asarray(Yinit).copy()
 
@@ -483,7 +522,33 @@ def train(
 
     x, y = remove_duplicates(x, y)
 
-    cls = resolve(surrogate_method_name, default_surrogate_methods)
+    kwargs = dict(surrogate_method_kwargs or {})
+    threshold = kwargs.pop("large_n_threshold", LARGE_N_THRESHOLD)
+    routed_name = _route_large_n(surrogate_method_name, len(x), threshold, logger)
+    cls = resolve(routed_name, default_surrogate_methods)
+    if routed_name != surrogate_method_name:
+        # The kwargs were tuned for the original (dense) surrogate; keep
+        # only the ones the sparse constructor names explicitly — the rest
+        # would be silently swallowed by its **kwargs — and say so.
+        params = inspect.signature(cls.__init__).parameters
+        named = {
+            k
+            for k, p in params.items()
+            if p.kind
+            in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+        }
+        dropped = sorted(k for k in kwargs if k not in named)
+        kwargs = {k: v for k, v in kwargs.items() if k in named}
+        if logger is not None and dropped:
+            logger.warning(
+                f"train: dropping surrogate kwargs not understood by "
+                f"'{routed_name}': {dropped}"
+            )
+        if logger is not None and kwargs:
+            logger.info(
+                f"train: forwarding kwargs to '{routed_name}' "
+                f"(reinterpreted under the sparse trainer): {sorted(kwargs)}"
+            )
     return cls(
         x,
         y,
@@ -491,7 +556,7 @@ def train(
         nOutput,
         xlb,
         xub,
-        **(surrogate_method_kwargs or {}),
+        **kwargs,
         logger=logger,
         return_mean_variance=surrogate_return_mean_variance,
     )

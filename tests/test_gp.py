@@ -113,3 +113,102 @@ def test_gp_single_output():
     m = GPR_Matern(X, Y[:, 0], 3, 1, np.zeros(3), np.ones(3), seed=1, **FAST)
     mu, var = m.predict(X[:7])
     assert mu.shape == (7, 1)
+
+
+# ------------------------------------------------------- large-N routing
+
+
+def test_large_n_routing_logic():
+    """Dense-kernel registry names reroute to svgp past the threshold;
+    import paths and sub-threshold sets are honored as given."""
+    from dmosopt_tpu.moasmo import _route_large_n
+
+    assert _route_large_n("gpr", 5000, 4096) == "svgp"
+    assert _route_large_n("megp", 5000, 4096) == "svgp"
+    assert _route_large_n("mdgp", 5000, 4096) == "svgp"
+    assert _route_large_n("vgp", 5000, 4096) == "svgp"  # inducing set = N
+    assert _route_large_n("gpr", 4096, 4096) == "gpr"  # at threshold: keep
+    assert _route_large_n("svgp", 9999, 4096) == "svgp"
+    # custom import paths are never rerouted
+    assert (
+        _route_large_n("my.pkg.MySurrogate", 9999, 4096) == "my.pkg.MySurrogate"
+    )
+    # None/0 disables
+    assert _route_large_n("gpr", 9999, None) == "gpr"
+    assert _route_large_n("gpr", 9999, 0) == "gpr"
+
+
+@pytest.mark.slow
+def test_large_n_train_routes_and_fits_10k():
+    """moasmo.train at N=10k must not build the dense (N,N) kernel: the
+    fit routes to the sparse family and completes on the CPU mesh
+    (VERDICT r2 item 7; reference chunks instead,
+    model_gpytorch.py:53-100)."""
+    from dmosopt_tpu import moasmo
+    from dmosopt_tpu.models.svgp import SVGP_Matern
+
+    rng = np.random.default_rng(7)
+    N, dim = 10_000, 6
+    X = rng.random((N, dim))
+    Y = np.stack(
+        [np.sin(3.0 * X[:, 0]) + X[:, 1] ** 2, X.sum(axis=1)], axis=1
+    )
+    m = moasmo.train(
+        dim,
+        2,
+        np.zeros(dim),
+        np.ones(dim),
+        X,
+        Y,
+        None,
+        surrogate_method_name="gpr",
+        surrogate_method_kwargs={
+            "inducing_fraction": 0.01,
+            "min_inducing": 64,
+            "n_iter": 60,
+            "batch_size": 512,
+        },
+    )
+    assert isinstance(m, SVGP_Matern)
+    mu, var = m.predict(X[:200])
+    assert np.all(np.isfinite(np.asarray(mu)))
+    assert np.all(np.asarray(var) > 0)
+    # sparse fit still tracks the function
+    mae = np.abs(np.asarray(mu) - Y[:200]).mean()
+    assert mae < 0.5, mae
+
+
+def test_large_n_reroute_filters_gpr_kwargs():
+    """On reroute, kwargs tuned for the dense GP that the sparse trainer
+    does not name are dropped (not silently swallowed by **kwargs)."""
+    from dmosopt_tpu import moasmo
+    from dmosopt_tpu.models.svgp import SVGP_Matern
+
+    rng = np.random.default_rng(3)
+    N, dim = 64, 3
+    X = rng.random((N, dim))
+    Y = np.stack([X[:, 0], X.sum(axis=1)], axis=1)
+    m = moasmo.train(
+        dim,
+        2,
+        np.zeros(dim),
+        np.ones(dim),
+        X,
+        Y,
+        None,
+        surrogate_method_name="gpr",
+        surrogate_method_kwargs={
+            "large_n_threshold": 32,
+            # GPR-only knobs: must be dropped on reroute, not passed through
+            "n_starts": 4,
+            "length_scale_bounds": (1e-2, 10.0),
+            # shared/sparse knobs: forwarded
+            "n_iter": 20,
+            "min_inducing": 8,
+            "inducing_fraction": 0.1,
+            "batch_size": 32,
+        },
+    )
+    assert isinstance(m, SVGP_Matern)
+    mu, var = m.predict(X[:5])
+    assert np.all(np.isfinite(np.asarray(mu)))

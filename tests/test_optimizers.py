@@ -108,11 +108,9 @@ def test_cmaes_trs_solution_quality_oracles():
     front = zdt1_pareto(1000)
     cases = [
         ("cmaes", CMAES, "zdt1", 30, 2, zdt1,
-         lambda y: np.min(np.linalg.norm(y[:, None] - front[None], axis=2), axis=1),
-         0.175, 5),
+         lambda y: distance_to_front(y, front), 0.175, 5),
         ("trs", TRS, "zdt1", 30, 2, zdt1,
-         lambda y: np.min(np.linalg.norm(y[:, None] - front[None], axis=2), axis=1),
-         0.5, 0),
+         lambda y: distance_to_front(y, front), 0.5, 0),
         ("cmaes", CMAES, "dtlz2", 12, 3, lambda X: dtlz2(X, n_obj=3),
          lambda y: np.abs(np.linalg.norm(y, axis=1) - 1.0), 0.2, 20),
         ("trs", TRS, "dtlz2", 12, 3, lambda X: dtlz2(X, n_obj=3),
