@@ -52,34 +52,37 @@ dopt_dict: Dict[str, "DistOptimizer"] = {}
 # ------------------------------------------------------ objective wrappers
 
 
+def _merge_eval_params(pp, param_space, vals, nested):
+    """Combine the fixed problem parameters `pp` with one sampled point
+    `vals` into the dict handed to the user's objective. Flat spaces get
+    a plain name->value dict (fixed integer parameters cast back to int);
+    nested spaces are deep-merged along their dotted paths."""
+    if nested:
+        base = pp.unflatten() if pp is not None else {}
+        return update_nested_dict(base, param_space.unflatten(vals))
+    fixed = (
+        {}
+        if pp is None
+        else {
+            it.name: int(it.value) if it.is_integer else it.value
+            for it in pp.items
+        }
+    )
+    return {**fixed, **dict(zip(param_space.parameter_names, vals))}
+
+
 def eval_obj_fun_sp(
     obj_fun, pp, param_space, nested_parameter_space, obj_fun_args, problem_id,
     space_vals,
 ):
     """Single-problem objective evaluation
     (reference: dmosopt/dmosopt.py:2327-2363)."""
-    this_space_vals = space_vals[problem_id]
-    if nested_parameter_space:
-        this_pp = update_nested_dict(
-            pp.unflatten() if pp is not None else {},
-            param_space.unflatten(this_space_vals),
-        )
-    else:
-        this_pp = {}
-        if pp is not None:
-            this_pp.update(
-                (item.name, int(item.value) if item.is_integer else item.value)
-                for item in pp.items
-            )
-        this_pp.update(
-            (param_name, this_space_vals[i])
-            for i, param_name in enumerate(param_space.parameter_names)
-        )
-    if obj_fun_args is None:
-        obj_fun_args = ()
-    t = time.time()
-    result = obj_fun(this_pp, *obj_fun_args)
-    return {problem_id: result, "time": time.time() - t}
+    merged = _merge_eval_params(
+        pp, param_space, space_vals[problem_id], nested_parameter_space
+    )
+    started = time.time()
+    result = obj_fun(merged, *(obj_fun_args or ()))
+    return {problem_id: result, "time": time.time() - started}
 
 
 def eval_obj_fun_mp(
@@ -90,32 +93,14 @@ def eval_obj_fun_mp(
     (reference: dmosopt/dmosopt.py:2366-2409). Iterates the problems
     present in `space_vals` (a subset of `problem_ids` when per-problem
     request queues have unequal lengths)."""
-    mpp = {}
-    for problem_id in space_vals:
-        this_space_vals = space_vals[problem_id]
-        if nested_parameter_space:
-            this_pp = update_nested_dict(
-                pp.unflatten() if pp is not None else {},
-                param_space.unflatten(this_space_vals),
-            )
-        else:
-            this_pp = {}
-            if pp is not None:
-                this_pp.update(
-                    (item.name, int(item.value) if item.is_integer else item.value)
-                    for item in pp.items
-                )
-            this_pp.update(
-                (param_name, this_space_vals[i])
-                for i, param_name in enumerate(param_space.parameter_names)
-            )
-        mpp[problem_id] = this_pp
-    if obj_fun_args is None:
-        obj_fun_args = ()
-    t = time.time()
-    result_dict = obj_fun(mpp, *obj_fun_args)
-    result_dict["time"] = time.time() - t
-    return result_dict
+    mpp = {
+        pid: _merge_eval_params(pp, param_space, vals, nested_parameter_space)
+        for pid, vals in space_vals.items()
+    }
+    started = time.time()
+    results = obj_fun(mpp, *(obj_fun_args or ()))
+    results["time"] = time.time() - started
+    return results
 
 
 # ----------------------------------------------------------------- driver
@@ -126,53 +111,35 @@ class DistOptimizer:
         self,
         opt_id,
         obj_fun,
-        obj_fun_args=None,
-        objective_names=None,
-        feature_dtypes=None,
-        feature_class=None,
-        constraint_names=None,
-        n_initial=10,
-        initial_maxiter=5,
-        initial_method="slh",
-        dynamic_initial_sampling=None,
-        dynamic_initial_sampling_kwargs=None,
-        verbose=False,
-        reduce_fun=None,
-        reduce_fun_args=None,
-        problem_ids=None,
-        problem_parameters=None,
-        space=None,
-        population_size=100,
-        num_generations=200,
+        *,
+        # problem definition
+        space=None, nested_parameter_space=False,
+        problem_parameters=None, problem_ids=None,
+        objective_names=None, constraint_names=None,
+        feature_dtypes=None, feature_class=None,
+        obj_fun_args=None, reduce_fun=None, reduce_fun_args=None,
+        # budget and loop shape
+        n_epochs=10, population_size=100, num_generations=200,
         resample_fraction=0.25,
-        distance_metric=None,
-        n_epochs=10,
-        save_eval=10,
-        file_path=None,
-        save=False,
-        save_surrogate_evals=False,
-        save_optimizer_params=True,
-        metadata=None,
-        nested_parameter_space=False,
-        surrogate_method_name="gpr",
-        surrogate_method_kwargs=None,
-        surrogate_custom_training=None,
-        surrogate_custom_training_kwargs=None,
-        optimizer_name="nsga2",
-        optimizer_kwargs=None,
-        sensitivity_method_name=None,
-        sensitivity_method_kwargs=None,
+        n_initial=10, initial_method="slh", initial_maxiter=5,
+        dynamic_initial_sampling=None, dynamic_initial_sampling_kwargs=None,
+        distance_metric=None, termination_conditions=None, time_limit=None,
+        # method selection
+        optimizer_name="nsga2", optimizer_kwargs=None,
+        surrogate_method_name="gpr", surrogate_method_kwargs=None,
+        surrogate_custom_training=None, surrogate_custom_training_kwargs=None,
         optimize_mean_variance=False,
-        local_random=None,
-        random_seed=None,
-        feasibility_method_name=None,
-        feasibility_method_kwargs=None,
-        termination_conditions=None,
-        jax_objective=False,
-        evaluator=None,
-        n_eval_workers=1,
-        mesh=None,
-        time_limit=None,
+        sensitivity_method_name=None, sensitivity_method_kwargs=None,
+        feasibility_method_name=None, feasibility_method_kwargs=None,
+        # randomness
+        random_seed=None, local_random=None,
+        # persistence
+        file_path=None, save=False, save_eval=10,
+        save_surrogate_evals=False, save_optimizer_params=True,
+        metadata=None,
+        # execution backend (TPU-specific)
+        jax_objective=False, evaluator=None, n_eval_workers=1, mesh=None,
+        verbose=False,
         **kwargs,
     ) -> None:
         """MO-ASMO optimization driver (see reference
@@ -295,12 +262,9 @@ class DistOptimizer:
         self.problem_ids = problem_ids if self.has_problem_ids else set([0])
         self._flatten_di_kwargs(param_space)
 
-        self.epoch_count = 0
-        self.saved_eval_count = 0
-        self.eval_count = 0
-        self.optimizer_dict = {}
-        self.storage_dict = {}
-        self.stats = {}
+        # run-progress counters and per-problem registries
+        self.epoch_count = self.saved_eval_count = self.eval_count = 0
+        self.optimizer_dict, self.storage_dict, self.stats = {}, {}, {}
 
         self.feature_constructor = (
             import_object_by_path(feature_class)
@@ -343,11 +307,10 @@ class DistOptimizer:
             from dmosopt_tpu.storage import init_h5
 
             init_h5(
-                self.opt_id, self.problem_ids, self.has_problem_ids,
-                self.param_space, self.param_names, self.objective_names,
-                self.feature_dtypes, self.constraint_names,
-                self.problem_parameters, self.metadata, self.random_seed,
-                self.file_path,
+                self.opt_id, self.problem_ids, self.has_problem_ids, self.param_space,
+                self.param_names, self.objective_names, self.feature_dtypes,
+                self.constraint_names, self.problem_parameters, self.metadata,
+                self.random_seed, self.file_path,
                 surrogate_mean_variance=self.optimize_mean_variance,
             )
 
@@ -438,43 +401,28 @@ class DistOptimizer:
             c = np.vstack([e.constraints for e in evals])
         return (epochs, x, y, f, c)
 
+    # driver attributes forwarded verbatim to every per-problem strategy
+    _STRATEGY_FIELDS = (
+        "resample_fraction", "population_size", "num_generations",
+        "initial_maxiter", "initial_method", "distance_metric",
+        "surrogate_method_name", "surrogate_method_kwargs",
+        "surrogate_custom_training", "surrogate_custom_training_kwargs",
+        "sensitivity_method_name", "sensitivity_method_kwargs",
+        "optimizer_name", "optimizer_kwargs",
+        "feasibility_method_name", "feasibility_method_kwargs",
+        "termination_conditions", "optimize_mean_variance",
+        "local_random", "logger", "file_path", "mesh",
+    )
+
     def _strategy_spec(self):
         """Constructor kwargs shared by every per-problem strategy."""
-        return dict(
-            resample_fraction=self.resample_fraction,
-            population_size=self.population_size,
-            num_generations=self.num_generations,
-            initial_maxiter=self.initial_maxiter,
-            initial_method=self.initial_method,
-            distance_metric=self.distance_metric,
-            surrogate_method_name=self.surrogate_method_name,
-            surrogate_method_kwargs=self.surrogate_method_kwargs,
-            surrogate_custom_training=self.surrogate_custom_training,
-            surrogate_custom_training_kwargs=self.surrogate_custom_training_kwargs,
-            sensitivity_method_name=self.sensitivity_method_name,
-            sensitivity_method_kwargs=self.sensitivity_method_kwargs,
-            optimizer_name=self.optimizer_name,
-            optimizer_kwargs=self.optimizer_kwargs,
-            feasibility_method_name=self.feasibility_method_name,
-            feasibility_method_kwargs=self.feasibility_method_kwargs,
-            termination_conditions=self.termination_conditions,
-            optimize_mean_variance=self.optimize_mean_variance,
-            local_random=self.local_random,
-            logger=self.logger,
-            file_path=self.file_path,
-            mesh=self.mesh,
-        )
+        return {name: getattr(self, name) for name in self._STRATEGY_FIELDS}
 
     def initialize_strategy(self):
         opt_prob = OptProblem(
-            self.param_names,
-            self.objective_names,
-            self.feature_dtypes,
-            self.feature_constructor,
-            self.constraint_names,
-            self.param_space,
-            self.eval_fun,
-            logger=self.logger,
+            self.param_names, self.objective_names, self.feature_dtypes,
+            self.feature_constructor, self.constraint_names, self.param_space,
+            self.eval_fun, logger=self.logger,
         )
         spec = self._strategy_spec()
         any_restored = False
@@ -486,7 +434,7 @@ class DistOptimizer:
                 self.start_epoch += 1
             any_restored = any_restored or initial is not None
             self.optimizer_dict[problem_id] = DistOptStrategy(
-                opt_prob, self.n_initial, initial=initial, **spec
+                opt_prob, n_initial=self.n_initial, initial=initial, **spec
             )
             self.storage_dict[problem_id] = []
         if any_restored:
@@ -526,8 +474,9 @@ class DistOptimizer:
             save_to_h5(
                 self.opt_id, self.problem_ids, self.has_problem_ids,
                 self.objective_names, self.feature_dtypes, self.constraint_names,
-                self.param_space, finished_evals, self.problem_parameters,
-                self.metadata, self.random_seed, self.file_path, self.logger,
+                self.param_space, finished_evals,
+                self.problem_parameters, self.metadata, self.random_seed,
+                self.file_path, self.logger,
                 surrogate_mean_variance=self.optimize_mean_variance,
             )
 
@@ -545,13 +494,8 @@ class DistOptimizer:
         from dmosopt_tpu.storage import save_optimizer_params_to_h5
 
         save_optimizer_params_to_h5(
-            self.opt_id,
-            problem_id,
-            epoch,
-            optimizer_name,
-            optimizer_params,
-            self.file_path,
-            self.logger,
+            self.opt_id, problem_id, epoch, optimizer_name, optimizer_params,
+            self.file_path, self.logger,
         )
 
     def save_stats(self, problem_id, epoch):
@@ -565,24 +509,30 @@ class DistOptimizer:
     # ------------------------------------------------------------ queries
 
     def get_best(self, feasible=True, return_features=False, return_constraints=False):
+        """Current best (non-dominated) evaluations per problem, as
+        (name, column) pair lists — optionally extended with the feature
+        records and named constraint columns."""
+
+        def named_columns(names, arr):
+            return None if arr is None else list(zip(names, list(arr.T)))
+
         best_results = {}
         for problem_id in self.problem_ids:
-            best_x, best_y, best_f, best_c = self.optimizer_dict[
-                problem_id
-            ].get_best_evals(feasible=feasible)
-            prms = list(zip(self.param_names, list(best_x.T)))
-            lres = list(zip(self.objective_names, list(best_y.T)))
-            lconstr = None
-            if self.constraint_names is not None and best_c is not None:
-                lconstr = list(zip(self.constraint_names, list(best_c.T)))
-            if return_features and return_constraints:
-                best_results[problem_id] = (prms, lres, best_f, lconstr)
-            elif return_features:
-                best_results[problem_id] = (prms, lres, best_f)
-            elif return_constraints:
-                best_results[problem_id] = (prms, lres, lconstr)
-            else:
-                best_results[problem_id] = (prms, lres)
+            strat = self.optimizer_dict[problem_id]
+            bx, by, bf, bc = strat.get_best_evals(feasible=feasible)
+            result = [
+                named_columns(self.param_names, bx),
+                named_columns(self.objective_names, by),
+            ]
+            if return_features:
+                result.append(bf)
+            if return_constraints:
+                result.append(
+                    named_columns(self.constraint_names, bc)
+                    if self.constraint_names is not None
+                    else None
+                )
+            best_results[problem_id] = tuple(result)
         return best_results if self.has_problem_ids else best_results[0]
 
     def print_best(self, feasible=True):
@@ -635,29 +585,29 @@ class DistOptimizer:
             task_args = []
             task_reqs = []
             while True:
-                eval_req_dict = {}
-                eval_x_dict = {}
+                round_reqs = {}
+                round_coords = {}
                 for problem_id in self.problem_ids:
-                    eval_req = self.optimizer_dict[problem_id].get_next_request()
-                    if eval_req is None:
+                    req = self.optimizer_dict[problem_id].get_next_request()
+                    if req is None:
                         continue  # this problem's queue is drained
-                    eval_req_dict[problem_id] = eval_req
-                    eval_x_dict[problem_id] = eval_req.parameters
-                if not eval_req_dict:
+                    round_reqs[problem_id] = req
+                    round_coords[problem_id] = req.parameters
+                if not round_reqs:
                     break
                 # partial rounds are allowed: per-problem queues can have
                 # unequal lengths (e.g. resample dedupe dropped different
                 # counts), and the evaluation wrappers iterate only the
                 # problems present in the submitted dict
-                task_args.append(eval_x_dict)
-                task_reqs.append(eval_req_dict)
+                task_args.append(round_coords)
+                task_reqs.append(round_reqs)
 
             if not task_args:
                 break
 
             results = self.evaluator.evaluate_batch(task_args)
 
-            for res, eval_req_dict in zip(results, task_reqs):
+            for res, round_reqs in zip(results, task_reqs):
                 if self.reduce_fun is not None:
                     res = (
                         self.reduce_fun(res)
@@ -666,7 +616,7 @@ class DistOptimizer:
                     )
                 t = res.pop("time", -1.0) if isinstance(res, dict) else -1.0
                 for problem_id, rres in res.items():
-                    eval_req = eval_req_dict[problem_id]
+                    eval_req = round_reqs[problem_id]
                     kwargs = {}
                     if (
                         self.feature_names is not None
@@ -732,15 +682,10 @@ class DistOptimizer:
         extra = self.dynamic_initial_sampling_kwargs or {}
         for round_idx in itertools.count():
             proposal = opt.xinit(
-                self.n_initial,
-                distopt.prob.param_names,
-                distopt.prob.lb,
-                distopt.prob.ub,
-                nPrevious=None,
-                maxiter=self.initial_maxiter,
-                method=self.initial_method,
-                local_random=self.local_random,
-                logger=self.logger,
+                self.n_initial, distopt.prob.param_names, distopt.prob.lb,
+                distopt.prob.ub, method=self.initial_method,
+                maxiter=self.initial_maxiter, nPrevious=None,
+                local_random=self.local_random, logger=self.logger,
             )
             batch = sampler_fn(
                 file_path=self.file_path,
@@ -783,21 +728,21 @@ class DistOptimizer:
     def run_epoch(self, completed_epoch: bool = False):
         """One full epoch: drain initial requests, run per-problem epoch
         state machines to completion (reference dmosopt.py:1341-1470)."""
-        epoch = self.epoch_count + self.start_epoch
-        advance_epoch = self.epoch_count < self.n_epochs - 1
+        epoch = self.start_epoch + self.epoch_count
+        advance_epoch = (self.epoch_count + 1) < self.n_epochs
 
         self.stats["init_sampling_start"] = time.time()
         self._process_requests()
-
-        for problem_id in self.problem_ids:
-            distopt = self.optimizer_dict[problem_id]
+        for strat in self.optimizer_dict.values():
             if self.dynamic_initial_sampling is not None and self.epoch_count == 0:
-                self._drain_dynamic_initial_samples(distopt)
-            distopt.initialize_epoch(epoch)
-
+                self._drain_dynamic_initial_samples(strat)
+            strat.initialize_epoch(epoch)
         self.stats["init_sampling_end"] = time.time()
 
-        while not completed_epoch:
+        # every problem must finish its own epoch state machine; problems
+        # that complete early stop being polled while the rest catch up
+        pending = set() if completed_epoch else set(self.problem_ids)
+        while pending:
             if self._time_exceeded():
                 # soft stop (reference dmosopt.py:1165-1168): pending
                 # requests are abandoned; state saved so far is kept
@@ -805,33 +750,15 @@ class DistOptimizer:
                 break
             self._process_requests()
 
-            for problem_id in self.problem_ids:
-                strategy_state, strategy_value, completed_evals = self.optimizer_dict[
+            for problem_id in sorted(pending):
+                state, res, completed_evals = self.optimizer_dict[
                     problem_id
                 ].update_epoch(resample=advance_epoch)
-                completed_epoch = strategy_state == StrategyState.CompletedEpoch
-                if not completed_epoch:
-                    continue
-                res = strategy_value
-
-                if (completed_evals is not None) and (epoch > 1):
-                    self._log_surrogate_accuracy(
-                        problem_id, epoch - 1, completed_evals
+                if state == StrategyState.CompletedEpoch:
+                    pending.discard(problem_id)
+                    self._finish_problem_epoch(
+                        problem_id, epoch, advance_epoch, res, completed_evals
                     )
-
-                if advance_epoch and epoch > 0:
-                    if self.save and self.save_surrogate_evals_:
-                        self.save_surrogate_evals(
-                            problem_id, epoch, res.gen_index, res.x, res.y
-                        )
-                    if self.save and self.save_optimizer_params_:
-                        optimizer = res.optimizer
-                        self.save_optimizer_params(
-                            problem_id,
-                            epoch,
-                            optimizer.name,
-                            optimizer.opt_parameters,
-                        )
 
         if self.save:
             for problem_id in self.problem_ids:
@@ -840,8 +767,46 @@ class DistOptimizer:
         self.epoch_count += 1
         return self.epoch_count
 
+    def _finish_problem_epoch(
+        self, problem_id, epoch, advance_epoch, res, completed_evals
+    ):
+        """Bookkeeping once one problem's epoch state machine completes:
+        surrogate-accuracy logging, then optional persistence of the
+        surrogate's inner-loop evaluations and optimizer state."""
+        if completed_evals is not None and epoch > 1:
+            self._log_surrogate_accuracy(problem_id, epoch - 1, completed_evals)
+        if not (self.save and advance_epoch and epoch > 0):
+            return
+        if self.save_surrogate_evals_:
+            self.save_surrogate_evals(
+                problem_id, epoch, res.gen_index, res.x, res.y
+            )
+        if self.save_optimizer_params_:
+            self.save_optimizer_params(
+                problem_id, epoch, res.optimizer.name,
+                res.optimizer.opt_parameters,
+            )
+
 
 # -------------------------------------------------------------------- run
+
+
+def _resolve_objective(params):
+    """The objective can arrive three ways — a callable (`obj_fun`), an
+    import path (`obj_fun_name`), or a factory path plus kwargs
+    (`obj_fun_init_name` / `obj_fun_init_args`); first present wins. All
+    spellings are consumed from `params` regardless of which one is used."""
+    fn = params.pop("obj_fun", None)
+    path = params.pop("obj_fun_name", None)
+    factory_path = params.pop("obj_fun_init_name", None)
+    factory_args = params.pop("obj_fun_init_args", None) or {}
+    if fn is not None:
+        return fn
+    if path is not None:
+        return import_object_by_path(path)
+    if factory_path is not None:
+        return import_object_by_path(factory_path)(**factory_args, worker=None)
+    raise RuntimeError("dmosopt_tpu.dopt_init: objfun is not provided")
 
 
 def dopt_init(dopt_params, verbose=False, initialize_strategy=False):
@@ -849,30 +814,18 @@ def dopt_init(dopt_params, verbose=False, initialize_strategy=False):
     by path when given as `obj_fun_name` / `obj_fun_init_name`
     (reference: dmosopt/dmosopt.py:2416-2465)."""
     dopt_params = dict(dopt_params)
-    objfun = dopt_params.pop("obj_fun", None)
-    if objfun is None:
-        objfun_name = dopt_params.pop("obj_fun_name", None)
-        if objfun_name is not None:
-            objfun = import_object_by_path(objfun_name)
-        else:
-            objfun_init_name = dopt_params.pop("obj_fun_init_name", None)
-            objfun_init_args = dopt_params.pop("obj_fun_init_args", None) or {}
-            if objfun_init_name is None:
-                raise RuntimeError("dmosopt_tpu.dopt_init: objfun is not provided")
-            objfun_init = import_object_by_path(objfun_init_name)
-            objfun = objfun_init(**objfun_init_args, worker=None)
-    else:
-        dopt_params.pop("obj_fun_name", None)
-    dopt_params["obj_fun"] = objfun
+    dopt_params["obj_fun"] = _resolve_objective(dopt_params)
 
     reducefun_name = dopt_params.pop("reduce_fun_name", None)
     if reducefun_name is not None:
         dopt_params["reduce_fun"] = import_object_by_path(reducefun_name)
 
-    ctrl_init_fun_name = dopt_params.pop("controller_init_fun_name", None)
-    ctrl_init_fun_args = dopt_params.pop("controller_init_fun_args", {})
-    if ctrl_init_fun_name is not None:
-        import_object_by_path(ctrl_init_fun_name)(**ctrl_init_fun_args)
+    # optional one-shot process setup hook (the reference runs this on the
+    # distwq controller before optimization starts)
+    ctrl_path = dopt_params.pop("controller_init_fun_name", None)
+    ctrl_args = dopt_params.pop("controller_init_fun_args", {})
+    if ctrl_path is not None:
+        import_object_by_path(ctrl_path)(**ctrl_args)
 
     dopt = DistOptimizer(**dopt_params, verbose=verbose)
     if initialize_strategy:
@@ -882,12 +835,8 @@ def dopt_init(dopt_params, verbose=False, initialize_strategy=False):
 
 
 def run(
-    dopt_params,
-    time_limit=None,
-    feasible=True,
-    return_features=False,
-    return_constraints=False,
-    verbose=True,
+    dopt_params, time_limit=None, feasible=True,
+    return_features=False, return_constraints=False, verbose=True,
     **kwargs,
 ):
     """Run a complete MO-ASMO optimization (reference:
@@ -899,8 +848,7 @@ def run(
         dopt_params = dict(dopt_params)
         dopt_params["time_limit"] = time_limit
     dopt = dopt_init(dopt_params, verbose=verbose, initialize_strategy=True)
-    logger = dopt.logger
-    logger.info(f"Optimizing for {dopt.n_epochs} epochs...")
+    dopt.logger.info(f"Optimizing for {dopt.n_epochs} epochs...")
     if dopt.n_epochs <= 0:
         dopt.run_epoch(completed_epoch=True)
     else:
@@ -908,7 +856,6 @@ def run(
             dopt.run_epoch()
     dopt.print_best()
     return dopt.get_best(
-        feasible=feasible,
-        return_features=return_features,
+        feasible=feasible, return_features=return_features,
         return_constraints=return_constraints,
     )

@@ -32,17 +32,14 @@ class ProgressivePrecisionScheduler:
 
     def __init__(
         self,
-        early_threshold: int = 20,
-        mid_threshold: int = 50,
-        early_epsilon: float = 0.05,
-        mid_epsilon: float = 0.02,
+        early_threshold: int = 20, mid_threshold: int = 50,
+        early_epsilon: float = 0.05, mid_epsilon: float = 0.02,
         late_epsilon: float = 0.01,
     ):
-        self.early_threshold = early_threshold
-        self.mid_threshold = mid_threshold
-        self.early_epsilon = early_epsilon
-        self.mid_epsilon = mid_epsilon
-        self.late_epsilon = late_epsilon
+        self.early_threshold, self.mid_threshold = early_threshold, mid_threshold
+        self.early_epsilon, self.mid_epsilon, self.late_epsilon = (
+            early_epsilon, mid_epsilon, late_epsilon,
+        )
 
     def get_epsilon(self, generation: int) -> float:
         if generation < self.early_threshold:
@@ -110,12 +107,9 @@ class MultiFidelityHVTracker:
     def __init__(
         self,
         reference_point: np.ndarray,
-        coarse_epsilon: float = 0.05,
-        medium_epsilon: float = 0.02,
+        coarse_epsilon: float = 0.05, medium_epsilon: float = 0.02,
         fine_epsilon: float = 0.01,
-        coarse_freq: int = 1,
-        medium_freq: int = 5,
-        fine_freq: int = 10,
+        coarse_freq: int = 1, medium_freq: int = 5, fine_freq: int = 10,
     ):
         self.reference_point = np.asarray(reference_point, dtype=np.float64)
         self.epsilons = {
@@ -168,15 +162,14 @@ class ConvergenceDetector:
 
     def __init__(
         self,
-        stagnation_threshold: float = 1e-5,
-        stagnation_window: int = 5,
-        relative_threshold: float = 1e-6,
-        min_generations: int = 20,
+        stagnation_threshold: float = 1e-5, stagnation_window: int = 5,
+        relative_threshold: float = 1e-6, min_generations: int = 20,
     ):
         self.stagnation_threshold = stagnation_threshold
-        self.stagnation_window = stagnation_window
+        self.stagnation_window, self.min_generations = (
+            stagnation_window, min_generations,
+        )
         self.relative_threshold = relative_threshold
-        self.min_generations = min_generations
 
     def check_convergence(
         self, tracker: MultiFidelityHVTracker, generation: int, F, verbose=False
@@ -212,29 +205,22 @@ class HypervolumeProgressTermination(SlidingWindowTermination):
         problem,
         ref_point: Optional[np.ndarray] = None,
         hv_tol: float = 1e-5,
-        n_last: int = 15,
-        nth_gen: int = 5,
+        n_last: int = 15, nth_gen: int = 5,
         n_max_gen: Optional[int] = None,
-        adaptive_ref_point: bool = True,
-        min_generations: int = 20,
+        adaptive_ref_point: bool = True, min_generations: int = 20,
         verbose: bool = False,
         **kwargs,
     ):
         super().__init__(
-            problem,
-            metric_window_size=n_last,
-            data_window_size=2,
-            min_data_for_metric=2,
-            nth_gen=nth_gen,
-            n_max_gen=n_max_gen,
+            problem, window_size=n_last, nth_gen=nth_gen, n_max_gen=n_max_gen,
             **kwargs,
         )
         self.ref_point = np.copy(ref_point) if ref_point is not None else None
-        self.hv_tol = hv_tol
-        self.adaptive_ref_point = adaptive_ref_point
+        self.hv_tol, self.adaptive_ref_point = hv_tol, adaptive_ref_point
         self.verbose = verbose
-        self._precision_scheduler = None
-        self._mf_tracker = None
+        # built lazily on the first snapshot, once the objective count and
+        # scale are known
+        self._precision_scheduler = self._mf_tracker = None
         self._convergence_detector = None
         self._convergence_detector_config = {
             "stagnation_threshold": hv_tol,
@@ -260,7 +246,7 @@ class HypervolumeProgressTermination(SlidingWindowTermination):
             **self._convergence_detector_config
         )
 
-    def _store(self, opt):
+    def _snapshot(self, opt):
         F = np.asarray(opt.y)
         self._initialize_components(F)
         if self.adaptive_ref_point:
@@ -268,31 +254,27 @@ class HypervolumeProgressTermination(SlidingWindowTermination):
             self._mf_tracker.reference_point = self.ref_point
         return {"F": F, "ref_point": self.ref_point.copy()}
 
-    def _metric(self, data):
-        current = data[-1]
-        F_current = current["F"]
-        generation = len(self._mf_tracker.state.history_coarse)
-        self._mf_tracker.compute_and_update(
-            F_current, generation, minimize=True, verbose=self.verbose
+    def _compare(self, previous, current):
+        F_now = current["F"]
+        tracker = self._mf_tracker
+        generation = len(tracker.state.history_coarse)
+        tracker.compute_and_update(
+            F_now, generation, minimize=True, verbose=self.verbose
         )
-        best_estimate = self._mf_tracker.get_best_estimate(generation, max_age=10)
-        hv_current = best_estimate.value if best_estimate else 0.0
-        history = self._mf_tracker.state.history_coarse
-        if len(history) >= 2:
-            hv_improvement = history[-1] - history[-2]
-            relative_improvement = hv_improvement / (history[-2] + 1e-10)
-        else:
-            hv_improvement, relative_improvement = 0.0, 0.0
-        result = self._convergence_detector.check_convergence(
-            self._mf_tracker, generation, F_current, verbose=self.verbose
+        best_estimate = tracker.get_best_estimate(generation, max_age=10)
+        history = tracker.state.history_coarse
+        gained = history[-1] - history[-2] if len(history) >= 2 else 0.0
+        rel_gain = gained / (history[-2] + 1e-10) if len(history) >= 2 else 0.0
+        verdict = self._convergence_detector.check_convergence(
+            tracker, generation, F_now, verbose=self.verbose
         )
         return {
-            "hv": hv_current,
-            "hv_improvement": hv_improvement,
-            "relative_improvement": relative_improvement,
-            "converged": result.converged,
-            "confidence": result.confidence,
-            "reason": result.primary_reason,
+            "hv": best_estimate.value if best_estimate else 0.0,
+            "hv_improvement": gained,
+            "relative_improvement": rel_gain,
+            "converged": verdict.converged,
+            "confidence": verdict.confidence,
+            "reason": verdict.primary_reason,
         }
 
     def _decide(self, metrics):

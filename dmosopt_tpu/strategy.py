@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import itertools
 from collections.abc import Iterator, Sequence
-from types import GeneratorType
 from typing import Dict, Optional, Union
 
 import numpy as np
@@ -58,30 +57,28 @@ class DistOptStrategy:
     def __init__(
         self,
         prob: OptProblem,
-        n_initial: int = 10,
-        initial=None,
-        initial_maxiter: int = 5,
-        initial_method: str = "slh",
-        population_size: int = 100,
+        *,
+        # initial design
+        n_initial: int = 10, initial=None,
+        initial_method: str = "slh", initial_maxiter: int = 5,
+        # inner-loop shape
+        population_size: int = 100, num_generations: int = 100,
         resample_fraction: float = 0.25,
-        num_generations: int = 100,
+        distance_metric=None, termination_conditions=None,
+        # method selection
+        optimizer_name: Union[str, Sequence] = "nsga2",
+        optimizer_kwargs: Union[Dict, Sequence, None] = None,
         surrogate_method_name: Optional[str] = "gpr",
         surrogate_method_kwargs: Optional[Dict] = None,
         surrogate_custom_training: Optional[str] = None,
         surrogate_custom_training_kwargs: Optional[Dict] = None,
         sensitivity_method_name: Optional[str] = None,
         sensitivity_method_kwargs: Optional[Dict] = None,
-        distance_metric=None,
-        optimizer_name: Union[str, Sequence] = "nsga2",
-        optimizer_kwargs: Union[Dict, Sequence, None] = None,
         feasibility_method_name=None,
         feasibility_method_kwargs: Optional[Dict] = None,
-        termination_conditions=None,
         optimize_mean_variance: bool = False,
-        local_random=None,
-        logger=None,
-        file_path=None,
-        mesh=None,
+        # runtime plumbing
+        local_random=None, logger=None, file_path=None, mesh=None,
     ):
         self.__dict__.update(
             prob=prob,
@@ -123,14 +120,9 @@ class DistOptStrategy:
         # already in the restored archive are filtered out lazily
         n_previous = None if self.x is None else self.x.shape[0]
         xinit = opt.xinit(
-            n_initial,
-            prob.param_names,
-            prob.lb,
-            prob.ub,
-            nPrevious=n_previous,
-            maxiter=initial_maxiter,
-            method=initial_method,
-            local_random=self.local_random,
+            n_initial, prob.param_names, prob.lb, prob.ub,
+            method=initial_method, maxiter=initial_maxiter,
+            nPrevious=n_previous, local_random=self.local_random,
             logger=self.logger,
         )
         self.reqs = []
@@ -195,14 +187,14 @@ class DistOptStrategy:
     def complete_request(
         self, x, y, epoch=None, f=None, c=None, pred=None, time=-1.0
     ) -> EvalEntry:
-        x = np.asarray(x)
-        y = np.asarray(y)
-        assert x.shape[0] == self.prob.dim
-        assert y.shape[0] == self.prob.n_objectives
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.shape[0] == self.prob.dim, (x.shape, self.prob.dim)
+        assert y.shape[0] == self.prob.n_objectives, (y.shape,)
         if self.optimize_mean_variance and pred is not None:
             if pred.shape[0] == self.prob.n_objectives:
+                # mean-only prediction: pad zero variances alongside
                 pred = np.column_stack((pred, np.zeros_like(pred)))
-        if (f is not None) and (np.ndim(f) == 1):
+        if f is not None and np.ndim(f) == 1:
             f = np.reshape(f, (1, -1))
         entry = EvalEntry(epoch, x, y, f, c, pred, time)
         self.completed.append(entry)
@@ -333,116 +325,88 @@ class DistOptStrategy:
         return spec
 
     def initialize_epoch(self, epoch_index: int):
-        assert self.opt_gen is None, (
-            "Optimization generator is active in DistOptStrategy"
-        )
+        if self.opt_gen is not None:
+            raise RuntimeError("an epoch is already active for this strategy")
         name, okw = self._cycled_optimizer()
         self._update_evals()
 
-        assert epoch_index > self.epoch_index
+        assert epoch_index > self.epoch_index, (epoch_index, self.epoch_index)
         self.epoch_index = epoch_index
         self.opt_gen = opt.epoch(
-            self.num_generations,
-            self.prob.param_names,
-            self.prob.objective_names,
-            self.prob.lb,
-            self.prob.ub,
-            self.resample_fraction,
-            self.x,
-            self.y,
-            self.c,
+            self.num_generations, self.prob.param_names,
+            self.prob.objective_names, self.prob.lb, self.prob.ub,
+            self.resample_fraction, self.x, self.y, self.c,
             **self._epoch_spec(name, okw),
         )
 
-        item = None
         try:
-            item = next(self.opt_gen)
+            x_gen, reduce_evals = next(self.opt_gen)
         except StopIteration as ex:
+            # surrogate mode: the epoch completed on-device in one shot;
+            # stash the result dict for update_epoch (ref dmosopt.py:352-358)
             self.opt_gen.close()
-            # surrogate mode: epoch completed on-device in one shot; stash
-            # the result dict for update_epoch (reference dmosopt.py:352-358)
             self.opt_gen = ex.value
+            return
 
-        if item is not None:
-            x_gen, reduce_evals = item
-            if reduce_evals:
-                self._reduce_evals()
-            for i in range(x_gen.shape[0]):
-                self.append_request(EvalRequest(x_gen[i, :], None, self.epoch_index))
+        if reduce_evals:
+            self._reduce_evals()
+        for row in x_gen:
+            self.append_request(EvalRequest(row, None, self.epoch_index))
 
-    def _complete_from_result(self, result_dict, resample: bool):
-        self.stats.update(result_dict.get("stats", {}))
-        if "best_x" in result_dict:
-            return StrategyState.CompletedEpoch, EpochResults(
-                result_dict["best_x"],
-                result_dict["best_y"],
-                result_dict["gen_index"],
-                result_dict["x"],
-                result_dict["y"],
-                result_dict["optimizer"],
-            )
-        x_resample = result_dict["x_resample"]
-        y_pred = result_dict["y_pred"]
+    def _complete_from_result(self, res, resample: bool):
+        """Convert the epoch generator's terminal result dict into
+        (CompletedEpoch, EpochResults); surrogate-mode results also enqueue
+        the resample batch for real evaluation next epoch."""
+        self.stats.update(res.get("stats", {}))
+        if "best_x" in res:  # no-surrogate mode: archive bests, no resample
+            picked = (res["best_x"], res["best_y"], res["gen_index"],
+                      res["x"], res["y"], res["optimizer"])
+            return StrategyState.CompletedEpoch, EpochResults(*picked)
+        x_resample, y_pred = res["x_resample"], res["y_pred"]
         if resample and x_resample is not None:
-            for i in range(x_resample.shape[0]):
+            for row, pred in zip(x_resample, y_pred):
                 self.append_request(
-                    EvalRequest(x_resample[i, :], y_pred[i], self.epoch_index + 1)
+                    EvalRequest(row, pred, self.epoch_index + 1)
                 )
-        return StrategyState.CompletedEpoch, EpochResults(
-            x_resample,
-            y_pred,
-            result_dict["gen_index"],
-            result_dict["x_sm"],
-            result_dict["y_sm"],
-            result_dict["optimizer"],
-        )
+        picked = (x_resample, y_pred, res["gen_index"],
+                  res["x_sm"], res["y_sm"], res["optimizer"])
+        return StrategyState.CompletedEpoch, EpochResults(*picked)
 
     def update_epoch(self, resample: bool = False):
         """Advance the epoch state machine; returns
         (StrategyState, value, completed_evals) — reference dmosopt.py:368-504."""
         assert self.opt_gen is not None, "Epoch not initialized"
 
-        return_state = None
-        return_value = None
         completed_evals = self._update_evals()
-
         if completed_evals is None and self.has_requests():
             return StrategyState.WaitingRequests, None, None
 
+        # surrogate mode finished its whole epoch on-device during
+        # initialize_epoch; its stashed result dict completes immediately
+        if isinstance(self.opt_gen, dict):
+            stashed, self.opt_gen = self.opt_gen, None
+            state, value = self._complete_from_result(stashed, resample)
+            return state, value, completed_evals
+
         try:
-            if isinstance(self.opt_gen, dict):
-                result_dict = self.opt_gen
-                self.opt_gen = None
-                return_state, return_value = self._complete_from_result(
-                    result_dict, resample
-                )
-                return return_state, return_value, completed_evals
             if completed_evals is None:
                 item, reduce_evals = next(self.opt_gen)
             else:
-                x_gen, y_gen, c_gen = (
-                    completed_evals[0],
-                    completed_evals[1],
-                    completed_evals[4],
+                feedback = (
+                    completed_evals[0], completed_evals[1], completed_evals[4]
                 )
-                item, reduce_evals = self.opt_gen.send((x_gen, y_gen, c_gen))
+                item, reduce_evals = self.opt_gen.send(feedback)
         except StopIteration as ex:
-            if isinstance(self.opt_gen, GeneratorType):
-                self.opt_gen.close()
+            self.opt_gen.close()
             self.opt_gen = None
-            return_state, return_value = self._complete_from_result(
-                ex.value, resample
-            )
-        else:
-            if reduce_evals:
-                self._reduce_evals()
-            x_gen = item
-            for i in range(x_gen.shape[0]):
-                self.append_request(EvalRequest(x_gen[i, :], None, self.epoch_index))
-            return_state = StrategyState.EnqueuedRequests
-            return_value = x_gen
+            state, value = self._complete_from_result(ex.value, resample)
+            return state, value, completed_evals
 
-        return return_state, return_value, completed_evals
+        if reduce_evals:
+            self._reduce_evals()
+        for row in item:
+            self.append_request(EvalRequest(row, None, self.epoch_index))
+        return StrategyState.EnqueuedRequests, item, completed_evals
 
     # ------------------------------------------------------------ queries
 
@@ -450,13 +414,8 @@ class DistOptStrategy:
         if self.x is None:
             return None, None, None, None
         bestx, besty, bestf, bestc, _, _ = opt.get_best(
-            self.x,
-            self.y,
-            self.f,
-            self.c,
-            self.prob.dim,
-            self.prob.n_objectives,
-            feasible=feasible,
+            self.x, self.y, self.f, self.c,
+            self.prob.dim, self.prob.n_objectives, feasible=feasible,
         )
         return bestx, besty, self.prob.feature_constructor(bestf), bestc
 

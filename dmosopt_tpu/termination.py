@@ -1,19 +1,30 @@
-"""Classic termination criteria (reference: dmosopt/termination.py,
-pymoo-derived).
+"""Classic termination criteria (capability parity with the reference's
+pymoo-derived dmosopt/termination.py, redesigned around a pairwise
+snapshot comparison).
 
 These are host-side controllers reading population metrics; with the
 on-device generation loop they are consulted every
 `termination_check_interval` generations (see moasmo._optimize_on_device)
 instead of every generation, amortizing the device->host sync.
+
+Design note: the reference carries a general data-window protocol
+(`_store`/`_metric`/`_decide` over arbitrary-size windows,
+termination.py:90-190), but every criterion it ships instantiates that
+machinery with a window of exactly two — each metric is a comparison of
+the current population statistic against the previous one. This module
+keeps only that pair (``_snapshot`` -> ``_compare``) plus a bounded
+metric window, which is the whole behavior in a third of the moving
+parts.
 """
 
 from __future__ import annotations
 
 from abc import abstractmethod
+from collections import deque
 
 import numpy as np
 
-from dmosopt_tpu.indicators import IGD, SlidingWindow
+from dmosopt_tpu.indicators import IGD
 from dmosopt_tpu.normalization import normalize
 
 
@@ -92,63 +103,55 @@ class MaximumGenerationTermination(Termination):
 
 
 class SlidingWindowTermination(TerminationCollection):
-    """Metric-over-window framework (reference termination.py:90-190)."""
+    """Pairwise comparison over a bounded metric window.
 
-    def __init__(
-        self,
-        problem,
-        metric_window_size=None,
-        data_window_size=None,
-        min_data_for_metric=1,
-        nth_gen=1,
-        n_max_gen=None,
-        truncate_metrics=True,
-        truncate_data=True,
-    ):
+    Each check takes a ``_snapshot`` of the population, compares it with
+    the previous snapshot (``_compare``), and appends the comparison to
+    a window holding the last ``window_size`` results; once the window
+    is full, ``_decide`` rules every ``nth_gen`` generations. A
+    ``_snapshot`` returning None leaves the previous snapshot in place
+    (e.g. non-numeric populations). Also carries the reference's
+    max-generation backstop.
+    """
+
+    def __init__(self, problem, window_size=10, nth_gen=1, n_max_gen=None):
         super().__init__(
             problem, MaximumGenerationTermination(problem, n_max_gen=n_max_gen)
         )
-        self.data_window_size = data_window_size
-        self.metric_window_size = metric_window_size
-        self.truncate_data = truncate_data
-        self.data = SlidingWindow(data_window_size) if truncate_data else []
-        self.truncate_metrics = truncate_metrics
-        self.metrics = SlidingWindow(metric_window_size) if truncate_metrics else []
+        self.window_size = window_size
         self.nth_gen = nth_gen
-        self.min_data_for_metric = min_data_for_metric
+        self.reset()
 
     def reset(self):
-        self.data = SlidingWindow(self.data_window_size) if self.truncate_data else []
-        self.metrics = (
-            SlidingWindow(self.metric_window_size) if self.truncate_metrics else []
-        )
+        self._previous = None
+        self.metrics = deque(maxlen=self.window_size)
 
     def _do_continue(self, opt):
         if not super()._do_continue(opt):
             return False
-        obj = self._store(opt)
-        if obj is not None:
-            self.data.append(obj)
-        if len(self.data) >= self.min_data_for_metric:
-            metric = self._metric(self.data[-self.data_window_size :])
-            if metric is not None:
-                self.metrics.append(metric)
-        if (
-            opt.n_gen % self.nth_gen == 0
-            and len(self.metrics) >= self.metric_window_size
-        ):
-            return self._decide(self.metrics[-self.metric_window_size :])
+        snap = self._snapshot(opt)
+        if snap is not None:
+            if self._previous is not None:
+                measured = self._compare(self._previous, snap)
+                if measured is not None:
+                    self.metrics.append(measured)
+            self._previous = snap
+        ready = len(self.metrics) == self.window_size
+        if ready and opt.n_gen % self.nth_gen == 0:
+            return self._decide(list(self.metrics))
         return True
 
-    def _store(self, opt):
+    def _snapshot(self, opt):
+        """Statistic of the current population to compare across
+        generations; None to skip this generation."""
         return opt
 
     @abstractmethod
-    def _decide(self, metrics):  # pragma: no cover
+    def _compare(self, previous, current):  # pragma: no cover
         ...
 
     @abstractmethod
-    def _metric(self, data):  # pragma: no cover
+    def _decide(self, metrics):  # pragma: no cover
         ...
 
     def get_metric(self):
@@ -156,43 +159,36 @@ class SlidingWindowTermination(TerminationCollection):
 
 
 class ParameterToleranceTermination(SlidingWindowTermination):
-    """IGD of consecutive normalized parameter populations below tol
-    (reference termination.py:193-231)."""
+    """Movement (IGD) of consecutive normalized parameter populations
+    below tol (capability of reference termination.py:193-231)."""
 
-    def __init__(self, problem, n_last=10, tol=1e-6, nth_gen=1, n_max_gen=None, **kw):
+    def __init__(self, problem, n_last=10, tol=1e-6, nth_gen=1, n_max_gen=None):
         super().__init__(
-            problem,
-            metric_window_size=n_last,
-            data_window_size=2,
-            min_data_for_metric=2,
-            nth_gen=nth_gen,
-            n_max_gen=n_max_gen,
-            **kw,
+            problem, window_size=n_last, nth_gen=nth_gen, n_max_gen=n_max_gen
         )
         self.tol = tol
 
-    def _store(self, opt):
-        X = opt.x
-        if X.dtype != object:
-            lb = getattr(self.problem, "lb", None)
-            ub = getattr(self.problem, "ub", None)
-            if lb is not None and ub is not None:
-                X = normalize(X, xl=lb, xu=ub)
+    def _snapshot(self, opt):
+        X = np.asarray(opt.x)
+        if X.dtype == object:  # non-numeric population: nothing to measure
+            return None
+        lb = getattr(self.problem, "lb", None)
+        ub = getattr(self.problem, "ub", None)
+        if lb is None or ub is None:
             return X
-        return None
+        return normalize(X, xl=lb, xu=ub)
 
-    def _metric(self, data):
-        last, current = data[-2], data[-1]
-        return IGD(current).do(last)
+    def _compare(self, previous, current):
+        return IGD(current).do(previous)
 
     def _decide(self, metrics):
-        metrics_mean = float(np.asarray(metrics).mean())
-        if metrics_mean <= self.tol:
+        mean_movement = float(np.mean(metrics))
+        if mean_movement <= self.tol:
             self._log(
                 f"Optimization terminated: mean parameter distance "
-                f"{metrics_mean} is below tolerance {self.tol}"
+                f"{mean_movement} is below tolerance {self.tol}"
             )
-        return metrics_mean > self.tol
+        return mean_movement > self.tol
 
 
 def calc_delta_norm(a, b, norm):
@@ -200,80 +196,65 @@ def calc_delta_norm(a, b, norm):
 
 
 class MultiObjectiveToleranceTermination(SlidingWindowTermination):
-    """Ideal/nadir delta + population IGD below tol
-    (reference termination.py:234-292)."""
+    """Ideal-point drift + population IGD below tol (capability of
+    reference termination.py:234-292)."""
 
-    def __init__(self, problem, tol=0.0025, n_last=10, nth_gen=1, n_max_gen=None, **kw):
+    def __init__(self, problem, tol=0.0025, n_last=10, nth_gen=1, n_max_gen=None):
         super().__init__(
-            problem,
-            metric_window_size=n_last,
-            data_window_size=2,
-            min_data_for_metric=2,
-            nth_gen=nth_gen,
-            n_max_gen=n_max_gen,
-            **kw,
+            problem, window_size=n_last, nth_gen=nth_gen, n_max_gen=n_max_gen
         )
         self.tol = tol
 
-    def _store(self, opt):
+    def _snapshot(self, opt):
         F = np.asarray(opt.y)
         return {"ideal": F.min(axis=0), "nadir": F.max(axis=0), "F": F}
 
-    def _metric(self, data):
-        last, current = data[-2], data[-1]
-        norm = current["nadir"] - current["ideal"]
-        norm = np.where(norm < 1e-32, 1.0, norm)
-        delta_ideal = calc_delta_norm(current["ideal"], last["ideal"], norm)
-        c_F, c_ideal, c_nadir = current["F"], current["ideal"], current["nadir"]
-        c_N = normalize(c_F, c_ideal, c_nadir)
-        l_N = normalize(last["F"], c_ideal, c_nadir)
-        delta_f = IGD(c_N).do(l_N)
-        return {"delta_ideal": delta_ideal, "delta_f": delta_f}
+    def _compare(self, previous, current):
+        ideal, nadir = current["ideal"], current["nadir"]
+        span = nadir - ideal
+        span = np.where(span < 1e-32, 1.0, span)
+        moved_ideal = calc_delta_norm(ideal, previous["ideal"], span)
+        # both fronts in the CURRENT normalization, then population IGD
+        now_n = normalize(current["F"], ideal, nadir)
+        before_n = normalize(previous["F"], ideal, nadir)
+        return {"delta_ideal": moved_ideal, "delta_f": IGD(now_n).do(before_n)}
 
     def _decide(self, metrics):
-        delta_ideal = np.mean([e["delta_ideal"] for e in metrics])
-        delta_f = np.mean([e["delta_f"] for e in metrics])
-        max_delta = max(delta_ideal, delta_f)
-        if max_delta <= self.tol:
+        drift = np.mean([m["delta_ideal"] for m in metrics])
+        movement = np.mean([m["delta_f"] for m in metrics])
+        if max(drift, movement) <= self.tol:
             self._log(
                 f"Optimization terminated: convergence of objective mean "
-                f"delta {(delta_ideal, delta_f)} is below tolerance {self.tol}"
+                f"delta {(drift, movement)} is below tolerance {self.tol}"
             )
-        return max_delta > self.tol
+        return max(drift, movement) > self.tol
 
 
 class ConstraintViolationToleranceTermination(SlidingWindowTermination):
-    """Constraint-violation change below tol while infeasible
-    (reference termination.py:295-330)."""
+    """Constraint-violation change below tol while still infeasible
+    (capability of reference termination.py:295-330)."""
 
-    def __init__(self, problem, n_last=10, tol=1e-6, nth_gen=1, n_max_gen=None, **kw):
+    def __init__(self, problem, n_last=10, tol=1e-6, nth_gen=1, n_max_gen=None):
         super().__init__(
-            problem,
-            metric_window_size=n_last,
-            data_window_size=2,
-            min_data_for_metric=2,
-            nth_gen=nth_gen,
-            n_max_gen=n_max_gen,
-            **kw,
+            problem, window_size=n_last, nth_gen=nth_gen, n_max_gen=n_max_gen
         )
         self.tol = tol
 
-    def _store(self, opt):
+    def _snapshot(self, opt):
         return opt.c
 
-    def _metric(self, data):
-        last, current = data[-2], data[-1]
-        return {"cv": current, "delta_cv": abs(last - current)}
+    def _compare(self, previous, current):
+        return {"cv": current, "delta_cv": abs(previous - current)}
 
     def _decide(self, metrics):
-        cv = np.asarray([e["cv"] for e in metrics])
-        delta_cv = np.asarray([e["delta_cv"] for e in metrics])
-        n_feasible = (cv > 0).sum()
-        if n_feasible == len(metrics):
-            return False
-        if 0 < n_feasible < len(metrics):
-            return True
-        return delta_cv.max() > self.tol
+        cv = np.asarray([m["cv"] for m in metrics])
+        feasible_count = int((cv > 0).sum())
+        if feasible_count == len(metrics):
+            return False  # feasible throughout the window: defer to others
+        if feasible_count > 0:
+            return True  # mixed window: still transitioning
+        deltas = np.asarray([m["delta_cv"] for m in metrics])
+        return deltas.max() > self.tol
 
 
 class StandardTermination(TerminationCollection):

@@ -1,5 +1,10 @@
 """BenchmarkRunner end-to-end capture tests (capability match of the
-reference's tests/test_moo_benchmarks.py:25-216 harness)."""
+reference's tests/test_moo_benchmarks.py:25-216 harness).
+
+One DTLZ2 benchmark run is shared (module-scoped fixture) between the
+capture-fields test and the summary test; the trajectory-monotonicity
+test needs its own multi-epoch run on DTLZ7.
+"""
 
 import json
 
@@ -18,9 +23,16 @@ FAST = dict(
 )
 
 
-def test_runner_captures_dtlz2(tmp_path):
-    runner = BenchmarkRunner(output_dir=str(tmp_path))
+@pytest.fixture(scope="module")
+def dtlz2_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("runner")
+    runner = BenchmarkRunner(output_dir=str(out))
     res = runner.run_single_benchmark("dtlz2", 3, **FAST)
+    return runner, res, out
+
+
+def test_runner_captures_dtlz2(dtlz2_run):
+    _, res, out = dtlz2_run
 
     assert isinstance(res, BenchmarkResult)
     assert res.problem_name == "dtlz2"
@@ -33,9 +45,34 @@ def test_runner_captures_dtlz2(tmp_path):
     assert res.n_archive > 0
     assert res.metadata["pf_shape"] == "concave"
 
-    payload = json.loads((tmp_path / "dtlz2_m3_result.json").read_text())
+    payload = json.loads((out / "dtlz2_m3_result.json").read_text())
     assert payload["final_hv"] == pytest.approx(res.final_hv)
     assert payload["hv_trajectory"] == res.hv_trajectory
+
+
+def test_runner_summary(dtlz2_run):
+    runner, res, out = dtlz2_run
+    runner.save_summary()
+    rows = json.loads((out / "summary.json").read_text())
+    assert len(rows) == 1 and rows[0]["problem_name"] == "dtlz2"
+    assert rows[0]["n_objectives"] == 3
+    assert rows[0]["final_hv"] == pytest.approx(res.final_hv)
+
+
+def test_runner_maf2_many_objective(tmp_path):
+    """The 5-objective path through the runner (ref-point sizing,
+    save_json=False) — minimal budget; problem math itself is oracle-
+    tested in test_benchmarks.py."""
+    runner = BenchmarkRunner(output_dir=str(tmp_path))
+    res = runner.run_single_benchmark(
+        "maf2", 5, save_json=False,
+        **{**FAST, "n_epochs": 1, "num_generations": 3, "population_size": 8},
+    )
+    assert res.n_objectives == 5
+    assert res.final_hv > 0.0
+    runner.save_summary()
+    rows = json.loads((tmp_path / "summary.json").read_text())
+    assert rows[0]["problem_name"] == "maf2" and rows[0]["n_objectives"] == 5
 
 
 def test_runner_hv_improves_on_dtlz7(tmp_path):
@@ -49,12 +86,3 @@ def test_runner_hv_improves_on_dtlz7(tmp_path):
     assert len(traj) == 3
     # archive only grows; HV against a fixed reference is monotone
     assert traj[-1] >= traj[0] - 1e-9, traj
-
-
-def test_runner_summary(tmp_path):
-    runner = BenchmarkRunner(output_dir=str(tmp_path))
-    runner.run_single_benchmark("maf2", 5, save_json=False, **FAST)
-    runner.save_summary()
-    rows = json.loads((tmp_path / "summary.json").read_text())
-    assert len(rows) == 1 and rows[0]["problem_name"] == "maf2"
-    assert rows[0]["n_objectives"] == 5

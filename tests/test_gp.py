@@ -165,8 +165,8 @@ def test_large_n_train_routes_and_fits_10k():
         surrogate_method_kwargs={
             "inducing_fraction": 0.01,
             "min_inducing": 64,
-            "n_iter": 60,
-            "batch_size": 512,
+            "n_iter": 30,
+            "batch_size": 256,
         },
     )
     assert isinstance(m, SVGP_Matern)

@@ -292,7 +292,7 @@ def test_fpras_large_archive_prune_speed_and_agreement():
     def run(prune):
         t0 = time.time()
         est, (ci, ns) = hypervolume_fpras(
-            pts, ref, epsilon=0.01, key=jax.random.PRNGKey(1),
+            pts, ref, epsilon=0.015, key=jax.random.PRNGKey(1),
             return_info=True, prune=prune,
         )
         return est, ci, ns, time.time() - t0

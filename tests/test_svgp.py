@@ -28,6 +28,8 @@ def _data(n=200, d_in=4, seed=0):
 
 
 FIT_KW = dict(n_iter=200, batch_size=128, seed=0)
+# for tests asserting only shapes/interfaces, not fit quality
+SHAPE_KW = dict(n_iter=40, batch_size=128, seed=0)
 
 
 @pytest.mark.parametrize(
@@ -50,16 +52,16 @@ def test_svgp_uses_fewer_inducing_points():
     X, Y = _data(n=300)
     m = SVGP_Matern(
         X, Y, 4, 2, np.zeros(4), np.ones(4),
-        inducing_fraction=0.2, min_inducing=30, **FIT_KW,
+        inducing_fraction=0.2, min_inducing=30, **SHAPE_KW,
     )
     assert m.fit.params.Z.shape[1] == 60  # 0.2 * 300
-    v = VGP_Matern(X, Y, 4, 2, np.zeros(4), np.ones(4), **FIT_KW)
+    v = VGP_Matern(X, Y, 4, 2, np.zeros(4), np.ones(4), **SHAPE_KW)
     assert v.fit.params.Z.shape[1] == 300
 
 
 def test_crv_has_mixing_matrix():
     X, Y = _data()
-    m = CRV_Matern(X, Y, 4, 2, np.zeros(4), np.ones(4), **FIT_KW)
+    m = CRV_Matern(X, Y, 4, 2, np.zeros(4), np.ones(4), **SHAPE_KW)
     assert m.fit.params.W is not None
     assert m.fit.params.W.shape == (2, 2)
 
@@ -68,7 +70,7 @@ def test_svgp_mean_variance_interface():
     X, Y = _data(n=120)
     m = SVGP_Matern(
         X, Y, 4, 2, np.zeros(4), np.ones(4),
-        return_mean_variance=True, **FIT_KW,
+        return_mean_variance=True, **SHAPE_KW,
     )
     out = m.evaluate(X[:10])
     assert isinstance(out, tuple) and len(out) == 2
@@ -94,7 +96,7 @@ def test_svgp_in_moasmo_epoch():
         pop=16,
         optimizer_name="nsga2",
         surrogate_method_name="svgp",
-        surrogate_method_kwargs={"n_iter": 100, "min_inducing": 40, "seed": 0},
+        surrogate_method_kwargs={"n_iter": 60, "min_inducing": 40, "seed": 0},
         local_random=2,
     )
     with pytest.raises(StopIteration) as ex:
