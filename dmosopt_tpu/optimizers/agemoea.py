@@ -140,14 +140,17 @@ def _survival_score(y, front_mask, ideal):
     crowd = jnp.where(selected, _INF, 0.0)
     n_greedy = jnp.maximum(m - selected.sum(), 0)
 
+    # Each point's two smallest distances to the selected set, maintained
+    # incrementally: recomputing them from the masked (N, N) matrix every
+    # iteration makes the greedy loop O(N^2) per step; folding in only the
+    # newly selected column keeps it O(N).
+    Dsel = jnp.where(selected[None, :], D, _INF)
+    neg_top2, _ = jax.lax.top_k(-Dsel, 2)
+    min1, min2 = -neg_top2[:, 0], -neg_top2[:, 1]
+
     def body(i, carry):
-        crowd, selected = carry
+        crowd, selected, min1, min2 = carry
         remaining = front_mask & ~selected
-        # per remaining point: sum of its 2 smallest distances to selected
-        Dm = jnp.where(selected[None, :], D, _INF)
-        neg_top2, _ = jax.lax.top_k(-Dm, 2)
-        min1 = -neg_top2[:, 0]
-        min2 = -neg_top2[:, 1]
         n_sel = selected.sum()
         val = min1 + jnp.where(n_sel >= 2, min2, 0.0)
         val = jnp.where(remaining, val, -_INF)
@@ -155,9 +158,17 @@ def _survival_score(y, front_mask, ideal):
         do = (i < n_greedy) & jnp.any(remaining)
         crowd = jnp.where(do, crowd.at[best].set(val[best]), crowd)
         selected = jnp.where(do, selected.at[best].set(True), selected)
-        return crowd, selected
+        # fold the newly selected point's distance column into the mins
+        dnew = jnp.where(do, D[:, best], _INF)
+        min1_next = jnp.minimum(min1, dnew)
+        min2_next = jnp.where(
+            dnew < min1, jnp.minimum(min2, min1), jnp.minimum(min2, dnew)
+        )
+        return crowd, selected, min1_next, min2_next
 
-    crowd, _ = jax.lax.fori_loop(0, N, body, (crowd, selected))
+    crowd, _, _, _ = jax.lax.fori_loop(
+        0, N, body, (crowd, selected, min1, min2)
+    )
     crowd = jnp.where(front_mask, crowd, 0.0)
     return normalization, p, crowd
 
