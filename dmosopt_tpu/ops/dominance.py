@@ -35,8 +35,12 @@ def dominance_degree_matrix(Y: jax.Array) -> jax.Array:
     return (Y[:, None, :] <= Y[None, :, :]).sum(axis=-1).astype(jnp.int32)
 
 
-@jax.jit
-def non_dominated_rank(Y: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+@partial(jax.jit, static_argnames=("stop_count",))
+def non_dominated_rank(
+    Y: jax.Array,
+    mask: jax.Array | None = None,
+    stop_count: int | None = None,
+) -> jax.Array:
     """Rank points into non-dominated fronts (0 = best).
 
     Semantics match reference dmosopt/dda.py:50-133 (``dda_ns`` /
@@ -46,6 +50,12 @@ def non_dominated_rank(Y: jax.Array, mask: jax.Array | None = None) -> jax.Array
 
     Y: (n, d) objective matrix (minimization).
     mask: optional (n,) bool; invalid rows get rank ``n`` and never dominate.
+    stop_count: static; stop peeling once at least this many points are
+        ranked — survival selections of the best ``k`` of ``n`` only need
+        the fronts covering ``k``, and each peel is a full (n, n)
+        reduction. Leftover valid points get rank ``n - 1`` (a legal
+        segment index, ordered after every exactly-ranked front; relative
+        order beyond the cut is unspecified).
     Returns (n,) int32 ranks.
     """
     n, d = Y.shape
@@ -62,12 +72,14 @@ def non_dominated_rank(Y: jax.Array, mask: jax.Array | None = None) -> jax.Array
     else:
         valid = jnp.ones((n,), dtype=bool)
 
+    target = n if stop_count is None else min(int(stop_count), n)
+
     def cond(carry):
-        rank, alive, k = carry
-        return jnp.any(alive)
+        rank, alive, k, assigned = carry
+        return jnp.any(alive) & (assigned < target)
 
     def body(carry):
-        rank, alive, k = carry
+        rank, alive, k, assigned = carry
         # A point is in the current front iff no still-alive point dominates it.
         dominated = jnp.any(dom & alive[:, None], axis=0) & alive
         front = alive & ~dominated
@@ -75,10 +87,15 @@ def non_dominated_rank(Y: jax.Array, mask: jax.Array | None = None) -> jax.Array
         # keeps the loop total): if no point is free, take all remaining.
         front = jnp.where(jnp.any(front), front, alive)
         rank = jnp.where(front, k, rank)
-        return rank, alive & ~front, k + 1
+        return rank, alive & ~front, k + 1, assigned + front.sum()
 
     rank0 = jnp.full((n,), n, dtype=jnp.int32)
-    rank, _, _ = jax.lax.while_loop(cond, body, (rank0, valid, jnp.int32(0)))
+    rank, alive, _, _ = jax.lax.while_loop(
+        cond, body, (rank0, valid, jnp.int32(0), jnp.int32(0))
+    )
+    if stop_count is not None:
+        # valid points never reached by the stopped peel: clamp into range
+        rank = jnp.where(alive, n - 1, rank)
     return rank
 
 

@@ -34,15 +34,20 @@ def order_mo(
     x_distance_metrics: Optional[Sequence] = None,
     y_distance_metrics: Optional[Sequence] = ("crowding",),
     mask: jax.Array | None = None,
+    need: Optional[int] = None,
 ):
     """Permutation ordering the population best-first: primary key =
     non-dominated rank, then each y-distance (descending), then each
     x-distance (descending). Matches reference ``orderMO``
     (dmosopt/MOEA.py:300-347) lexsort semantics.
 
+    ``need`` (static): when only the best ``need`` positions of the
+    permutation matter (survival truncation), front peeling stops once
+    they are covered; the order beyond position ``need`` is unspecified.
+
     Returns (perm, rank_sorted, y_dists_sorted).
     """
-    rank = non_dominated_rank(y, mask=mask)
+    rank = non_dominated_rank(y, mask=mask, stop_count=need)
     y_fns = [resolve_metric(m) for m in (y_distance_metrics or [])]
     x_fns = [resolve_metric(m) for m in (x_distance_metrics or [])]
     y_dists = [fn(y, mask) if _accepts_mask(fn) else fn(y) for fn in y_fns]
@@ -68,11 +73,12 @@ def sort_mo(
     x_distance_metrics=None,
     y_distance_metrics=("crowding",),
     mask: jax.Array | None = None,
+    need: int | None = None,
 ):
     """Sorted copies of (x, y) best-first plus ranks — reference ``sortMO``
-    (dmosopt/MOEA.py:242-297)."""
+    (dmosopt/MOEA.py:242-297). ``need`` as in ``order_mo``."""
     perm, rank_sorted, y_dists_sorted = order_mo(
-        x, y, x_distance_metrics, y_distance_metrics, mask=mask
+        x, y, x_distance_metrics, y_distance_metrics, mask=mask, need=need
     )
     return x[perm], y[perm], rank_sorted, y_dists_sorted, perm
 
@@ -96,6 +102,7 @@ def remove_worst(
         x_distance_metrics=x_distance_metrics,
         y_distance_metrics=y_distance_metrics,
         mask=mask,
+        need=pop,
     )
     return xs[:pop], ys[:pop], rank[:pop], perm[:pop]
 

@@ -181,7 +181,7 @@ def environmental_selection(x, y, pop: int, x_keys=None):
     N, d = y.shape
     dup = duplicate_mask(x)
     valid = ~dup
-    rank = non_dominated_rank(y, mask=valid)
+    rank = non_dominated_rank(y, mask=valid, stop_count=pop)
 
     front1 = (rank == 0) & valid
     ideal = jnp.min(jnp.where(front1[:, None], y, _INF), axis=0)

@@ -274,7 +274,8 @@ class CMAES(MOEA):
         x, y = remove_duplicates(np.asarray(st.parents_x), np.asarray(st.parents_y))
         if len(x) > 0:
             xs, ys, _, _, _ = sort_mo(
-                jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32)
+                jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
+                need=self.popsize,
             )
             x = np.asarray(xs)[: self.popsize]
             y = np.asarray(ys)[: self.popsize]

@@ -102,6 +102,7 @@ class NSGA2(MOEA):
             y,
             x_distance_metrics=self.x_distance_metrics,
             y_distance_metrics=self.y_distance_metrics,
+            need=pop,
         )
         f32 = xs.dtype
         return NSGA2State(
@@ -187,6 +188,7 @@ class NSGA2(MOEA):
             obj,
             x_distance_metrics=self.x_distance_metrics,
             y_distance_metrics=self.y_distance_metrics,
+            need=pop,
         )
         keep = perm[:pop]
         survived_off = keep < noff  # offspring that made it

@@ -221,6 +221,7 @@ class SMPSO(MOEA):
                 cand_y,
                 x_distance_metrics=self.x_distance_metrics,
                 y_distance_metrics=self.y_distance_metrics,
+                need=P,
             )
             keep = perm[:P]
             n_surv = (keep < 2 * P).sum()

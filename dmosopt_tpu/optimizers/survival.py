@@ -48,11 +48,15 @@ def front_fill_selection(
 
     Returns (sel_idx, chosen, rank): ``sel_idx`` (popsize,) gather indices
     ordered by (rank, -crowding), ``chosen`` (N,) boolean mask, ``rank``
-    (N,) non-dominated rank of every candidate.
+    (N,) non-dominated ranks — exact for every selected candidate (and any
+    front touching the cut); candidates beyond the stopped peel carry the
+    sentinel ``N - 1``, not their true rank.
     """
     y = candidates_y.astype(jnp.float32)
     n = y.shape[0]
-    rank = non_dominated_rank(y)
+    # peel only the fronts covering the selection; leftovers rank n-1,
+    # whose front_start lands at/after popsize so they are never mid-front
+    rank = non_dominated_rank(y, stop_count=popsize)
 
     sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), rank, num_segments=n)
     starts = jnp.cumsum(sizes) - sizes

@@ -218,7 +218,10 @@ class DistOptStrategy:
         """Trim the archive to the best `population_size` points
         (reference dmosopt.py:219-229)."""
         self._remove_duplicate_evals()
-        perm, _, _ = order_mo(jnp.asarray(self.x), jnp.asarray(self.y))
+        perm, _, _ = order_mo(
+            jnp.asarray(self.x), jnp.asarray(self.y),
+            need=self.population_size,
+        )
         perm = np.asarray(perm)[: self.population_size]
         self.x = self.x[perm, :]
         self.y = self.y[perm, :]
