@@ -6,5 +6,13 @@ PY := env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python
 test:
 	$(PY) -m pytest tests/ -q
 
+# the four slow evidence tests (DCN loopback, 10k fits, archive-scale
+# FPRAS, solution-quality oracles) — excluded from the default run
+test-slow:
+	$(PY) -m pytest tests/ -q -m slow
+
+test-all:
+	$(PY) -m pytest tests/ -q -m ""
+
 bench:
 	python bench.py
