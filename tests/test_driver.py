@@ -170,3 +170,66 @@ def test_run_validates_params():
     with pytest.raises(ValueError):
         dmosopt_tpu.run({"opt_id": "x", "obj_fun": zdt1_obj,
                          "objective_names": ["f1"]}, verbose=False)
+
+
+def test_run_dotted_flat_space(tmp_path):
+    """Dotted parameter names in a flat space survive the whole loop with
+    h5 persistence (capability of reference tests/test_zdt1_age_dotname.py)."""
+    def obj(pp):
+        x = np.asarray([pp[k] for k in sorted(pp)])
+        return np.asarray([x[0], 1.0 - x[0] + float((x[1:] ** 2).sum())])
+
+    fp = str(tmp_path / "dotname.h5")
+    names = [f"x.{i+1}" for i in range(4)]
+    best = dmosopt_tpu.run(_base_params(
+        opt_id="dotname",
+        obj_fun=obj,
+        space={n: [0.0, 1.0] for n in names},
+        objective_names=["y1", "y2"],
+        population_size=16,
+        num_generations=5,
+        surrogate_method_kwargs={"n_starts": 2, "n_iter": 20},
+        n_initial=2,
+        n_epochs=2,
+        random_seed=11,
+        optimizer_name="age",
+        file_path=fp,
+        save=True,
+    ), verbose=False)
+    prms, lres = best
+    assert [n for n, _ in prms] == names
+    assert np.all(np.isfinite(np.column_stack([v for _, v in lres])))
+    # the dotted names must survive in storage verbatim
+    from dmosopt_tpu.storage import h5_load_raw
+
+    raw = h5_load_raw(fp, "dotname")
+    assert list(raw["parameter_space"].parameter_names) == names
+
+
+def test_run_nested_parameter_space():
+    """nested_parameter_space=True hands the objective a nested dict built
+    from dotted paths (capability of reference tests/test_zdt1_age_nested.py)."""
+    seen = {}
+
+    def obj(pp):
+        # the merged dict must arrive nested: {"a": {"x1","x2"}, "b": {"x3"}}
+        seen["keys"] = (sorted(pp), sorted(pp.get("a", {})))
+        x = np.asarray([pp["a"]["x1"], pp["a"]["x2"], pp["b"]["x3"]])
+        return np.asarray([x[0], 1.0 - x[0] + float((x[1:] ** 2).sum())])
+
+    best = dmosopt_tpu.run(_base_params(
+        opt_id="nested_space",
+        obj_fun=obj,
+        space={"a": {"x1": [0.0, 1.0], "x2": [0.0, 1.0]}, "b": {"x3": [0.0, 1.0]}},
+        nested_parameter_space=True,
+        objective_names=["y1", "y2"],
+        population_size=16,
+        num_generations=5,
+        surrogate_method_kwargs={"n_starts": 2, "n_iter": 20},
+        n_initial=2,
+        n_epochs=2,
+        random_seed=12,
+    ), verbose=False)
+    assert seen["keys"] == (["a", "b"], ["x1", "x2"])
+    prms, lres = best
+    assert np.all(np.isfinite(np.column_stack([v for _, v in lres])))

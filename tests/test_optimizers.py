@@ -203,3 +203,48 @@ def test_optimizer_cycling_nsga2_trs():
     y = np.column_stack([v for _, v in lres])
     d = distance_to_front(y, FRONT)
     assert (d < 0.15).sum() >= 8, (len(d), float(np.median(d)))
+
+
+def test_cmaes_cholesky_update_invariants():
+    """Oracle for the batched rank-1 Cholesky update (capability of
+    reference tests/test_update_cholesky.py): after the update,
+    A_new A_new^T == alpha (A A^T) + ccov pc_new pc_new^T and
+    Ainv_new == A_new^{-1}, on both the active (psucc < pthresh) and
+    passive branches."""
+    from dmosopt_tpu.optimizers.cmaes import _update_cholesky_batch
+
+    rng = np.random.default_rng(5)
+    B, n = 4, 6
+    cc, ccov, pthresh = 0.2, 0.3, 0.44
+    # random SPD Cholesky factors + inverses
+    A = np.stack([np.linalg.cholesky(
+        (lambda M: M @ M.T + n * np.eye(n))(rng.normal(size=(n, n)))
+    ) for _ in range(B)]).astype(np.float32)
+    Ainv = np.linalg.inv(A).astype(np.float32)
+    z = rng.normal(size=(B, n)).astype(np.float32)
+    pc = rng.normal(size=(B, n)).astype(np.float32)
+    psucc = np.array([0.1, 0.9, 0.2, 0.8], np.float32)  # both branches
+
+    A2, Ainv2, pc2 = map(
+        np.asarray,
+        _update_cholesky_batch(
+            jnp.asarray(A), jnp.asarray(Ainv), jnp.asarray(z),
+            jnp.asarray(psucc), jnp.asarray(pc), cc, ccov, pthresh,
+        ),
+    )
+
+    below = psucc < pthresh
+    pc_expect = np.where(
+        below[:, None],
+        (1 - cc) * pc + np.sqrt(cc * (2 - cc)) * z,
+        (1 - cc) * pc,
+    )
+    np.testing.assert_allclose(pc2, pc_expect, rtol=1e-5, atol=1e-6)
+    alpha = np.where(below, 1 - ccov, (1 - ccov) + ccov * cc * (2 - cc))
+    for b in range(B):
+        C_new = A2[b] @ A2[b].T
+        C_expect = alpha[b] * (A[b] @ A[b].T) + ccov * np.outer(pc2[b], pc2[b])
+        np.testing.assert_allclose(C_new, C_expect, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            Ainv2[b] @ A2[b], np.eye(n), rtol=1e-3, atol=2e-3
+        )
