@@ -51,17 +51,34 @@ def _stack_evals(entries):
 @click.option("--constraints/--no-constraints", default=True)
 @click.option("--knn", default=0, type=int,
               help="rank the k best points nearest the normalized origin")
+@click.option("--sort-key", type=str, multiple=True,
+              help="objective name(s) to sort the rows by (repeatable; "
+                   "first given is the primary key)")
 @click.option("--filter-objectives", type=str, default=None,
               help="comma-separated subset of objectives")
 @click.option("--output-file", type=click.Path(), default=None)
 @click.option("--verbose", "-v", is_flag=True)
-def analyze(file_path, opt_id, constraints, knn, filter_objectives,
+def analyze(file_path, opt_id, constraints, knn, sort_key, filter_objectives,
             output_file, verbose):
     """Extract and rank the non-dominated set from a results store
     (intent of reference dmosopt_analyze.py)."""
     raw, problem_ids = _load(file_path, opt_id)
     objective_names = raw["objective_names"]
     param_names = raw["parameter_names"]
+
+    # displayed objective columns are problem-independent: filter and
+    # validate the sort keys once, before any Pareto extraction
+    names = list(objective_names)
+    keep = None
+    if filter_objectives is not None:
+        keep = [i for i, n in enumerate(names)
+                if n in set(filter_objectives.split(","))]
+        names = [names[i] for i in keep]
+    missing = [k for k in sort_key if k not in names]
+    if missing:
+        raise click.ClickException(
+            f"unknown sort key(s) {missing}; objectives: {names}"
+        )
 
     out = {}
     for problem_id in problem_ids:
@@ -70,13 +87,8 @@ def analyze(file_path, opt_id, constraints, knn, filter_objectives,
             click.echo(f"No results for id {problem_id}")
             continue
         x, y, f, c, epochs = _stack_evals(entries)
-
-        names = list(objective_names)
-        if filter_objectives is not None:
-            keep = [i for i, n in enumerate(names)
-                    if n in set(filter_objectives.split(","))]
+        if keep is not None:
             y = y[:, keep]
-            names = [names[i] for i in keep]
 
         click.echo(f"Found {x.shape[0]} results for id {problem_id}")
         best_x, best_y, best_f, best_c, best_epoch, _ = moasmo.get_best(
@@ -96,6 +108,13 @@ def analyze(file_path, opt_id, constraints, knn, filter_objectives,
                     pts[:, j] = pts[:, j] / mx
             d = np.linalg.norm(pts, axis=1)
             order = np.argsort(d)[: min(knn, len(d))]
+
+        if sort_key:
+            # order the (possibly knn-restricted) rows by named objective
+            # columns (reference dmosopt_analyze.py --sort-key); the first
+            # option given is the primary key
+            cols = [best_y[order, names.index(k)] for k in sort_key]
+            order = order[np.lexsort(tuple(reversed(cols)))]
 
         rows = OrderedDict()
         for i in order:

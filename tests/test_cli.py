@@ -91,3 +91,23 @@ def test_onestep(store, tmp_path):
     data = np.load(out)
     assert data["x_resample"].shape == (8, N_DIM)
     assert data["y_pred"].shape == (8, 2)
+
+
+def test_analyze_sort_key(store, tmp_path):
+    out = tmp_path / "sorted.json"
+    result = CliRunner().invoke(
+        analyze,
+        ["-p", store, "--opt-id", "cli_run", "--sort-key", "f1",
+         "--output-file", str(out)],
+    )
+    assert result.exit_code == 0, result.output
+    rows = list(json.loads(out.read_text())["0"].values())
+    f1s = [r["objectives"]["f1"] for r in rows]
+    assert f1s == sorted(f1s)
+
+    # unknown key errors cleanly
+    bad = CliRunner().invoke(
+        analyze, ["-p", store, "--opt-id", "cli_run", "--sort-key", "nope"]
+    )
+    assert bad.exit_code != 0
+    assert "unknown sort key" in bad.output
