@@ -550,14 +550,7 @@ def train(
                 f"(reinterpreted under the sparse trainer): {sorted(kwargs)}"
             )
     return cls(
-        x,
-        y,
-        nInput,
-        nOutput,
-        xlb,
-        xub,
-        **kwargs,
-        logger=logger,
+        x, y, nInput, nOutput, xlb, xub, **kwargs, logger=logger,
         return_mean_variance=surrogate_return_mean_variance,
     )
 
@@ -666,30 +659,29 @@ def epoch(
     mdl = Model(return_mean_variance=optimize_mean_variance)
     if surrogate_custom_training is not None:
         custom_training = import_object_by_path(surrogate_custom_training)
+        # the hook sees every method-selection option under its public name
+        options = {
+            name: (value if not name.endswith("_kwargs") else (value or {}))
+            for name, value in (
+                ("optimizer_name", optimizer_name),
+                ("optimizer_kwargs", optimizer_kwargs),
+                ("surrogate_method_name", surrogate_method_name),
+                ("surrogate_method_kwargs", surrogate_method_kwargs),
+                ("feasibility_method_name", feasibility_method_name),
+                ("feasibility_method_kwargs", feasibility_method_kwargs),
+                ("sensitivity_method_name", sensitivity_method_name),
+                ("sensitivity_method_kwargs", sensitivity_method_kwargs),
+                ("return_mean_variance", optimize_mean_variance),
+            )
+        }
         (
             optimizer_cls,
             mdl.objective,
             mdl.feasibility,
             mdl.sensitivity,
         ) = custom_training(
-            optimizer_cls,
-            Xinit,
-            Yinit,
-            C,
-            xlb,
-            xub,
-            file_path,
-            options={
-                "optimizer_name": optimizer_name,
-                "optimizer_kwargs": optimizer_kwargs or {},
-                "surrogate_method_name": surrogate_method_name,
-                "surrogate_method_kwargs": surrogate_method_kwargs or {},
-                "feasibility_method_name": feasibility_method_name,
-                "feasibility_method_kwargs": feasibility_method_kwargs or {},
-                "sensitivity_method_name": sensitivity_method_name,
-                "sensitivity_method_kwargs": sensitivity_method_kwargs or {},
-                "return_mean_variance": optimize_mean_variance,
-            },
+            optimizer_cls, Xinit, Yinit, C, xlb, xub, file_path,
+            options=options,
             **(surrogate_custom_training_kwargs or {}),
         )
 
@@ -713,18 +705,11 @@ def epoch(
 
     if surrogate_method_name is not None and mdl.objective is None:
         mdl.objective = train(
-            nInput,
-            nOutput,
-            xlb,
-            xub,
-            Xinit,
-            Yinit,
-            C,
+            nInput, nOutput, xlb, xub, Xinit, Yinit, C,
             surrogate_method_name=surrogate_method_name,
             surrogate_method_kwargs=surrogate_method_kwargs,
             surrogate_return_mean_variance=optimize_mean_variance,
-            logger=logger,
-            file_path=file_path,
+            logger=logger, file_path=file_path,
         )
 
     if sensitivity_method_name is not None and mdl.sensitivity is None:
@@ -732,11 +717,7 @@ def epoch(
         class _Sensitivity:
             def __init__(self):
                 self._di_dict = analyze_sensitivity(
-                    mdl.objective,
-                    xlb,
-                    xub,
-                    param_names,
-                    objective_names,
+                    mdl.objective, xlb, xub, param_names, objective_names,
                     sensitivity_method_name=sensitivity_method_name,
                     sensitivity_method_kwargs=sensitivity_method_kwargs,
                     logger=logger,
@@ -765,10 +746,7 @@ def epoch(
     stats.update(mdl.get_stats())
 
     optimizer = optimizer_cls(
-        nInput=nInput,
-        nOutput=nOutput,
-        popsize=pop,
-        model=mdl,
+        nInput=nInput, nOutput=nOutput, popsize=pop, model=mdl,
         distance_metric=None,
         optimize_mean_variance=optimize_mean_variance,
         **optimizer_kwargs_,
@@ -778,20 +756,10 @@ def epoch(
     _, (x_0, y_0) = _feasible_subset(C, x_0, y_0)
 
     opt_gen = optimize(
-        num_generations,
-        optimizer,
-        mdl,
-        nInput,
-        nOutput,
-        xlb,
-        xub,
-        initial=(x_0, y_0),
-        logger=logger,
-        popsize=pop,
-        local_random=local_random,
-        termination=termination,
+        num_generations, optimizer, mdl, nInput, nOutput, xlb, xub,
+        initial=(x_0, y_0), popsize=pop, local_random=local_random,
+        termination=termination, mesh=mesh, logger=logger,
         optimize_mean_variance=optimize_mean_variance,
-        mesh=mesh,
         **optimizer_kwargs_,
     )
 
@@ -827,22 +795,13 @@ def epoch(
         D = _as_np(crowding_distance(jnp.asarray(best_y)))
         idxr = D.argsort()[::-1][:N_resample]
         return {
-            "x_resample": best_x[idxr, :],
-            "y_pred": best_y[idxr, :],
-            "gen_index": gen_index,
-            "x_sm": x,
-            "y_sm": y,
-            "optimizer": optimizer,
-            "stats": stats,
+            "x_resample": best_x[idxr, :], "y_pred": best_y[idxr, :],
+            "gen_index": gen_index, "x_sm": x, "y_sm": y,
+            "optimizer": optimizer, "stats": stats,
         }
     return {
-        "best_x": best_x,
-        "best_y": best_y,
-        "gen_index": gen_index,
-        "x": x,
-        "y": y,
-        "optimizer": optimizer,
-        "stats": stats,
+        "best_x": best_x, "best_y": best_y, "gen_index": gen_index,
+        "x": x, "y": y, "optimizer": optimizer, "stats": stats,
     }
 
 
@@ -850,10 +809,7 @@ def epoch(
 
 
 def get_best(
-    x,
-    y,
-    f,
-    c,
+    x, y, f, c,
     nInput: int,
     nOutput: int,
     epochs=None,
@@ -877,15 +833,11 @@ def get_best(
         )
 
     if delete_duplicates:
-        is_duplicate = get_duplicates(ytmp)
-        xtmp = xtmp[~is_duplicate]
-        ytmp = ytmp[~is_duplicate]
-        if f is not None:
-            f = np.asarray(f)[~is_duplicate]
-        if c is not None:
-            c = np.asarray(c)[~is_duplicate]
-        if epochs is not None:
-            epochs = np.asarray(epochs)[~is_duplicate]
+        keep = ~get_duplicates(ytmp)
+        xtmp, ytmp = xtmp[keep], ytmp[keep]
+        f = np.asarray(f)[keep] if f is not None else None
+        c = np.asarray(c)[keep] if c is not None else None
+        epochs = np.asarray(epochs)[keep] if epochs is not None else None
 
     xs, ys, rank, _, perm = sort_mo(jnp.asarray(xtmp), jnp.asarray(ytmp))
     xs, ys, rank, perm = _as_np(xs), _as_np(ys), _as_np(rank), _as_np(perm)
