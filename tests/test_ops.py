@@ -227,3 +227,58 @@ def test_duplicate_mask(rng):
     got = np.asarray(duplicate_mask(jnp.asarray(X)))
     assert not got[:10].any()
     assert got[10:].all()
+
+
+def test_rank_stop_count_prefix_exact(rng):
+    """Early-stopped peeling: every front up to the covering cut matches
+    the full ranking; leftovers carry the legal sentinel n-1."""
+    Y = jnp.asarray(rng.random((300, 2)).astype(np.float32))
+    full = np.asarray(non_dominated_rank(Y))
+    stopped = np.asarray(non_dominated_rank(Y, stop_count=100))
+    n = Y.shape[0]
+    kmax = stopped[stopped < n - 1].max()  # last exactly-peeled front
+    covered = full <= kmax
+    assert covered.sum() >= 100
+    assert np.array_equal(full[covered], stopped[covered])
+    assert np.all(stopped[~covered] == n - 1)
+
+
+def test_agemoea_survival_matches_bruteforce_greedy(rng):
+    """The incremental two-smallest-distance maintenance in the AGE-MOEA
+    survival score must equal the brute-force greedy recomputation."""
+    from dmosopt_tpu.optimizers import agemoea as A
+
+    N, d, nf = 48, 3, 30
+    y = jnp.asarray(rng.random((N, d)).astype(np.float32))
+    mask = jnp.asarray(np.arange(N) < nf)
+    ideal = jnp.min(jnp.where(mask[:, None], y, A._INF), axis=0)
+    norm, p, crowd = map(np.asarray, A._survival_score(y, mask, ideal))
+
+    # brute-force reference: identical normalization and D, greedy loop
+    # recomputes the two smallest distances to the selected set each step
+    yf = (np.asarray(y) - np.asarray(ideal)[None]) / norm
+    pf = float(p)
+    D = np.sum(np.abs(yf[:, None] - yf[None, :]) ** pf, axis=2) ** (1 / pf)
+    nn = np.sum(np.abs(yf) ** pf, axis=1) ** (1 / pf)
+    D = D / np.where(nn[:, None] == 0, 1.0, nn[:, None])
+    maskn = np.asarray(mask)
+    extreme = np.asarray(A._find_corner_solutions(
+        jnp.asarray(np.asarray(y) - np.asarray(ideal)[None]), mask))
+    selected = np.zeros(N, bool)
+    selected[extreme] = True
+    selected &= maskn
+    expect = np.where(selected, np.inf, 0.0)
+    n_greedy = maskn.sum() - selected.sum()
+    for _ in range(int(n_greedy)):
+        remaining = maskn & ~selected
+        if not remaining.any():
+            break
+        Dm = np.where(selected[None, :], D, np.inf)
+        two = np.sort(Dm, axis=1)[:, :2]
+        val = two[:, 0] + (two[:, 1] if selected.sum() >= 2 else 0.0)
+        val = np.where(remaining, val, -np.inf)
+        best = int(np.argmax(val))
+        expect[best] = val[best]
+        selected[best] = True
+    expect = np.where(maskn, expect, 0.0)
+    np.testing.assert_allclose(crowd, expect, rtol=1e-4, atol=1e-5)
