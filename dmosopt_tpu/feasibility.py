@@ -57,18 +57,21 @@ def _fit_logistic_l1(X, y, mask, lam, n_steps=_N_STEPS, lr=0.1):
 
 
 @partial(jax.jit, static_argnames=("n_folds",))
-def _fit_constraint(X, y, key, n_folds=_N_FOLDS):
+def _fit_constraint(X, y, valid, key, n_folds=_N_FOLDS):
     """Fit one constraint classifier: CV-select lambda, refit on all data.
-    Returns (w, b, cv_scores)."""
+    ``valid`` masks out bucket-padding rows (see the wrapper: training
+    sets grow each epoch, so rows are padded to power-of-two buckets to
+    reuse compiled programs). Returns (w, b, cv_scores)."""
     n, d = X.shape
     fold = jax.random.permutation(key, n) % n_folds
 
     def fit_eval(lam, k):
-        train = fold != k
+        train = (fold != k) & valid
         w, b = _fit_logistic_l1(X, y, train.astype(X.dtype), lam)
+        held = (fold == k) & valid
         pred = (X @ w + b) > 0
-        correct = (pred == (y > 0.5)) & ~train
-        return correct.sum() / jnp.maximum((~train).sum(), 1)
+        correct = (pred == (y > 0.5)) & held
+        return correct.sum() / jnp.maximum(held.sum(), 1)
 
     scores = jax.vmap(
         lambda lam: jnp.mean(
@@ -76,7 +79,7 @@ def _fit_constraint(X, y, key, n_folds=_N_FOLDS):
         )
     )(_LAMBDAS)
     best = jnp.argmax(scores)
-    w, b = _fit_logistic_l1(X, y, jnp.ones((n,), X.dtype), _LAMBDAS[best])
+    w, b = _fit_logistic_l1(X, y, valid.astype(X.dtype), _LAMBDAS[best])
     return w, b, scores
 
 
@@ -100,23 +103,39 @@ class LogisticFeasibilityModel:
         self.rotation = Vt.T  # (d, k)
         Zr = Z @ self.rotation
 
+        # bucket-pad the sample axis (shared policy with the GP fits) and
+        # fix the feature axis at d, so the jitted CV program is reused as
+        # the archive grows across epochs. Pad rows carry valid=False; the
+        # k = min(n, d) < d PCA columns that don't exist yet are zero
+        # features, whose weights the L1 penalty keeps at zero.
+        from dmosopt_tpu.models.gp import _bucket_size
+
+        n, k_dim = Zr.shape
+        d = X.shape[1]
+        bucket = _bucket_size(n)
+        Zp = np.zeros((bucket, d), np.float32)
+        Zp[:n, :k_dim] = Zr
+        valid = jnp.asarray(np.arange(bucket) < n)
+        Zp = jnp.asarray(Zp)
+
         self.weights = []  # per-constraint (w, b) or None (single-class)
         key = jax.random.PRNGKey(seed or 0)
         for i in range(self.n_constraints):
-            c_i = (C[:, i] > 0.0).astype(np.float64)
+            c_i = (C[:, i] > 0.0).astype(np.float32)
             if len(np.unique(c_i)) <= 1:
                 self.weights.append(None)
                 continue
+            cp = np.zeros((bucket,), np.float32)
+            cp[:n] = c_i
             key, k = jax.random.split(key)
-            w, b, _ = _fit_constraint(
-                jnp.asarray(Zr, jnp.float32), jnp.asarray(c_i, jnp.float32), k
-            )
-            self.weights.append((np.asarray(w), float(b)))
+            w, b, _ = _fit_constraint(Zp, jnp.asarray(cp), valid, k)
+            # weights of the zero-feature pad columns are exactly 0 under
+            # the L1 prox (zero gradient, zero init); keep the real k_dim
+            self.weights.append((np.asarray(w)[:k_dim], float(b)))
 
         # stacked jax parameters so rank()/predict are traceable and can run
         # inside jitted EA steps (single-class constraints get w=0, b>>0 so
         # their feasibility probability is ~1)
-        k_dim = self.rotation.shape[1]
         Wm = np.zeros((self.n_constraints, k_dim))
         bv = np.full((self.n_constraints,), 30.0)
         for i, wb in enumerate(self.weights):
