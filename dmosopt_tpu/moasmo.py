@@ -501,9 +501,12 @@ def train(
     surrogate_return_mean_variance: bool = False,
     logger=None,
     file_path=None,
+    mesh=None,
 ):
     """Fit the objective surrogate on feasible, deduplicated data
-    (reference: dmosopt/MOASMO.py:473-532).
+    (reference: dmosopt/MOASMO.py:473-532). A `mesh` is forwarded to
+    surrogates whose constructor names it (the exact-GP family shards
+    its multi-start axis over the mesh's "model" axis when present).
 
     Dense-kernel surrogate names (gpr/egp/megp/mdgp/mdspp, plus vgp
     whose inducing set is the full training set) are rerouted
@@ -549,6 +552,15 @@ def train(
                 f"train: forwarding kwargs to '{routed_name}' "
                 f"(reinterpreted under the sparse trainer): {sorted(kwargs)}"
             )
+    if mesh is not None and "mesh" not in kwargs:
+        # walk the MRO: subclasses like EGP_Matern take (*args, **kwargs)
+        # and delegate to a base whose __init__ names mesh
+        if any(
+            "mesh" in inspect.signature(c.__init__).parameters
+            for c in type.mro(cls)
+            if "__init__" in c.__dict__
+        ):
+            kwargs["mesh"] = mesh
     return cls(
         x, y, nInput, nOutput, xlb, xub, **kwargs, logger=logger,
         return_mean_variance=surrogate_return_mean_variance,
@@ -709,7 +721,7 @@ def epoch(
             surrogate_method_name=surrogate_method_name,
             surrogate_method_kwargs=surrogate_method_kwargs,
             surrogate_return_mean_variance=optimize_mean_variance,
-            logger=logger, file_path=file_path,
+            logger=logger, file_path=file_path, mesh=mesh,
         )
 
     if sensitivity_method_name is not None and mdl.sensitivity is None:

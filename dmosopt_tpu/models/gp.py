@@ -183,7 +183,13 @@ def _select_better(improved, new_params: GPParams, best_params: GPParams) -> GPP
     return GPParams(*(pick(n, b) for n, b in zip(new_params, best_params)))
 
 
-@partial(jax.jit, static_argnames=("kernel", "n_starts", "n_iter", "ard", "rel_jitter"))
+@partial(
+    jax.jit,
+    static_argnames=(
+        "kernel", "n_starts", "n_iter", "ard", "rel_jitter",
+        "mesh", "model_axis",
+    ),
+)
 def fit_gp_batch(
     key: jax.Array,
     X: jax.Array,  # (N, n) unit box
@@ -198,6 +204,8 @@ def fit_gp_batch(
     ard: bool = False,
     rel_jitter: Optional[float] = None,
     train_mask: Optional[jax.Array] = None,
+    mesh=None,
+    model_axis: str = "model",
 ) -> GPFit:
     """Fit d independent GPs with S random restarts each, as one program.
 
@@ -206,6 +214,12 @@ def fit_gp_batch(
     reference model.py:1419-1753). `train_mask` (N,) marks real rows when X/Y
     are bucket-padded to a static shape (see `_pad_to_bucket`); masked fits
     are exactly the unpadded fits.
+
+    With a `mesh` carrying a `model_axis` whose size divides `n_starts`,
+    the restart axis of the whole Adam scan is sharded over that axis
+    (data/X replicated; XLA inserts the final cross-restart argmin
+    collective) — the second mesh axis next to the EA loop's population
+    axis (see `parallel/mesh.py`, `__graft_entry__.dryrun_multichip`).
     """
     N, n = X.shape
     if train_mask is not None:
@@ -237,6 +251,17 @@ def fit_gp_batch(
         u_ls=u0_ls + mask[:, None, None] * jitter_ls,
         u_noise=u0_noise + mask[:, None] * jitter_noise,
     )
+    if (
+        mesh is not None
+        and model_axis in mesh.axis_names
+        and n_starts % mesh.shape[model_axis] == 0
+    ):
+        from dmosopt_tpu.parallel.mesh import population_sharding
+
+        shard = population_sharding(mesh, model_axis)
+        params0 = jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, shard), params0
+        )
 
     # loss over the (S, d) grid: vmap over restarts, then objectives.
     def loss_one(p, y):
@@ -567,6 +592,7 @@ class GPR_Matern(SurrogateMixin):
         learning_rate: float = 0.1,
         dtype="float32",
         rel_jitter: Optional[float] = None,
+        mesh=None,
         logger=None,
         **kwargs,
     ):
@@ -595,6 +621,7 @@ class GPR_Matern(SurrogateMixin):
             learning_rate=learning_rate,
             ard=bool(anisotropic),
             rel_jitter=rel_jitter,
+            mesh=mesh,
         )
         self.fit = fit._replace(
             y_mean=jnp.asarray(y_mean, dt),

@@ -78,7 +78,7 @@ class JaxBatchEvaluator:
         batch_fun: Callable,
         problem_ids: Optional[Sequence] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
-        batch_axis: str = "batch",
+        batch_axis: Optional[str] = None,
         has_features: bool = False,
         has_constraints: bool = False,
     ):
@@ -87,6 +87,10 @@ class JaxBatchEvaluator:
         self.has_constraints = has_constraints
         self.mesh = mesh
         if mesh is not None:
+            # default to the mesh's leading axis — the population/batch
+            # axis by the repo's mesh convention (parallel/mesh.py)
+            if batch_axis is None:
+                batch_axis = mesh.axis_names[0]
             spec = jax.sharding.PartitionSpec(batch_axis)
             in_sharding = jax.sharding.NamedSharding(mesh, spec)
             self._fn = jax.jit(batch_fun, in_shardings=(in_sharding,))
