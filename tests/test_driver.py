@@ -233,3 +233,75 @@ def test_run_nested_parameter_space():
     assert seen["keys"] == (["a", "b"], ["x1", "x2"])
     prms, lres = best
     assert np.all(np.isfinite(np.column_stack([v for _, v in lres])))
+
+
+def test_run_optimize_mean_variance(tmp_path):
+    """optimize_mean_variance=True: the optimizer works on the surrogate's
+    (mean, variance) output and stored predictions carry 2d columns
+    (reference dmosopt.py surrogate_mean_variance path)."""
+    fp = str(tmp_path / "meanvar.h5")
+    best = dmosopt_tpu.run(_base_params(
+        opt_id="meanvar",
+        optimize_mean_variance=True,
+        population_size=16,
+        num_generations=5,
+        surrogate_method_kwargs={"n_starts": 2, "n_iter": 20, "seed": 0},
+        n_initial=2,
+        n_epochs=2,
+        random_seed=13,
+        file_path=fp,
+        save=True,
+    ), verbose=False)
+    prms, lres = best
+    y = np.column_stack([v for _, v in lres])
+    assert np.all(np.isfinite(y))
+    # persisted predictions carry [means..., variances...] columns, and
+    # resampled (epoch>0) evaluations actually have them
+    import h5py
+
+    with h5py.File(fp, "r") as f:
+        preds = np.asarray(f["meanvar"]["0"]["predictions"])
+        epochs = np.asarray(f["meanvar"]["0"]["epochs"])
+    n_obj = len(_base_params()["objective_names"])
+    assert preds.shape[1] == 2 * n_obj
+    assert np.isfinite(preds[epochs > 0]).all()
+    assert (epochs > 0).any()
+
+
+_quota_calls = []
+
+
+def _quota_sampler(file_path, iteration, evaluated_samples, next_samples,
+                   sampler, quota=12, **_):
+    """Round-by-round epoch-0 sampler (the reference's
+    dynamic_initial_sampling contract, dmosopt.py:1357-1402): request
+    4-point batches until `quota` evaluations exist, then stop."""
+    _quota_calls.append(iteration)
+    if len(evaluated_samples) >= quota:
+        return None
+    return np.asarray(next_samples)[:4]
+
+
+def test_dynamic_initial_sampling():
+    """The epoch-0 dynamic sampler hook drives extra evaluation rounds
+    until it returns None."""
+    _quota_calls.clear()
+    quota = 18
+    best = dmosopt_tpu.run(_base_params(
+        opt_id="dyninit",
+        dynamic_initial_sampling=f"{__name__}._quota_sampler",
+        dynamic_initial_sampling_kwargs={"quota": quota},
+        population_size=16,
+        num_generations=5,
+        surrogate_method_kwargs={"n_starts": 2, "n_iter": 20, "seed": 0},
+        n_initial=2,
+        n_epochs=2,
+        random_seed=14,
+    ), verbose=False)
+    from dmosopt_tpu.driver import dopt_dict
+
+    strat = dopt_dict["dyninit"].optimizer_dict[0]
+    assert len(_quota_calls) >= 2  # at least one extra round ran
+    assert strat.x.shape[0] >= quota  # archive holds the quota'd evals
+    prms, lres = best
+    assert np.all(np.isfinite(np.column_stack([v for _, v in lres])))
