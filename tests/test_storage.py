@@ -137,3 +137,69 @@ def test_resume_restores_space_from_file_alone(tmp_path):
     best = dmosopt_tpu.run(params, verbose=False)
     prms, lres = best
     assert len(prms) == N_DIM
+
+
+def test_multiproblem_constrained_resume(tmp_path):
+    """Resume a saved multi-problem constrained run: both problems'
+    archives restore and extend without re-evaluating stored points."""
+    import dmosopt_tpu
+    import dmosopt_tpu.driver as drv
+
+    DIM = 5
+
+    def mp_obj(mpp):
+        out = {}
+        for pid, pp in mpp.items():
+            x = np.array([pp[f"x{i}"] for i in range(DIM)])
+            y = np.array([x[0] + 0.01 * pid, 1.0 - x[0]])
+            out[pid] = (y, np.array([x[0] - 0.1]))
+        return out
+
+    fp = str(tmp_path / "mpres.h5")
+    params = {
+        "opt_id": "mpres",
+        "obj_fun": mp_obj,
+        "objective_names": ["f1", "f2"],
+        "constraint_names": ["c1"],
+        "problem_ids": set([0, 1]),
+        "space": {f"x{i}": [0.0, 1.0] for i in range(DIM)},
+        "problem_parameters": {},
+        "n_initial": 2,
+        "n_epochs": 2,
+        "population_size": 16,
+        "num_generations": 5,
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 15, "seed": 0},
+        "random_seed": 21,
+        "file_path": fp,
+        "save": True,
+    }
+    dmosopt_tpu.run(params, verbose=False)
+    n_before = {
+        pid: drv.dopt_dict["mpres"].optimizer_dict[pid].x.shape[0]
+        for pid in (0, 1)
+    }
+    drv.dopt_dict.clear()
+
+    dmosopt_tpu.run(params, verbose=False)  # resume from the same file
+    n_after = {
+        pid: drv.dopt_dict["mpres"].optimizer_dict[pid].x.shape[0]
+        for pid in (0, 1)
+    }
+    for pid in (0, 1):
+        assert n_after[pid] > n_before[pid], (n_before, n_after)
+        strat = drv.dopt_dict["mpres"].optimizer_dict[pid]
+        # constraints restored and carried through the resumed epochs
+        assert strat.c is not None and strat.c.shape == (n_after[pid], 1)
+
+    # no stored point was re-evaluated: the append-only h5 parameter log
+    # (every evaluation ever run) contains no duplicate rows
+    import h5py
+    from scipy.spatial.distance import cdist
+
+    with h5py.File(fp, "r") as f:
+        for pid in ("0", "1"):
+            P = np.asarray(f["mpres"][pid]["parameters"])
+            D = cdist(P, P)
+            np.fill_diagonal(D, np.inf)
+            assert D.min() > 1e-12, f"re-evaluated stored point, pid={pid}"
