@@ -305,3 +305,44 @@ def test_dynamic_initial_sampling():
     assert strat.x.shape[0] >= quota  # archive holds the quota'd evals
     prms, lres = best
     assert np.all(np.isfinite(np.column_stack([v for _, v in lres])))
+
+
+def test_run_with_sensitivity_analysis():
+    """sensitivity_method_name through run(): surrogate sensitivities map
+    to per-dimension distribution indices without disturbing the loop."""
+    best = dmosopt_tpu.run(_base_params(
+        opt_id="sa_run",
+        sensitivity_method_name="dgsm",
+        population_size=16, num_generations=5,
+        surrogate_method_kwargs={"n_starts": 2, "n_iter": 15, "seed": 0},
+        n_initial=2, n_epochs=2, random_seed=3,
+    ), verbose=False)
+    assert np.all(np.isfinite(np.column_stack([v for _, v in best[1]])))
+
+
+def test_run_jax_objective_with_constraints():
+    """jax_objective=True with constraints: the batched evaluator handles
+    the (y, c) tuple protocol and the feasibility path stays live."""
+    import jax.numpy as jnp
+
+    def obj_c(X):
+        y = jnp.stack(
+            [X[:, 0], 1.0 - X[:, 0] + jnp.sum(X[:, 1:] ** 2, axis=1)], axis=1
+        )
+        return y, X[:, :1] - 0.1  # feasible iff x0 > 0.1
+
+    best = dmosopt_tpu.run(_base_params(
+        opt_id="jaxc",
+        obj_fun=obj_c,
+        jax_objective=True,
+        constraint_names=["c1"],
+        feasibility_method_name="logreg",
+        population_size=16, num_generations=5,
+        surrogate_method_kwargs={"n_starts": 2, "n_iter": 15, "seed": 0},
+        n_initial=2, n_epochs=2, random_seed=3,
+    ), verbose=False)
+    from dmosopt_tpu.driver import dopt_dict
+
+    strat = dopt_dict["jaxc"].optimizer_dict[0]
+    assert strat.c is not None and strat.c.shape[1] == 1
+    assert np.all(np.isfinite(np.column_stack([v for _, v in best[1]])))
