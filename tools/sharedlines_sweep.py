@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Repo-wide shared-line sweep against the reference tree.
+
+For every package source file, reports the fraction of its normalized
+lines (see sharedlines.py) that appear anywhere in the reference
+(`union%`) and the single reference file with the most overlap. Usage:
+
+    python tools/sharedlines_sweep.py [--ref-dir /root/reference/dmosopt]
+"""
+
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent))
+from sharedlines import normalized_lines  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref-dir", default="/root/reference/dmosopt")
+    ap.add_argument("--package", default="dmosopt_tpu")
+    ap.add_argument("--min-lines", type=int, default=30)
+    args = ap.parse_args()
+
+    refs = {}
+    for r in pathlib.Path(args.ref_dir).rglob("*.py"):
+        refs[r.name] = set(s for s in normalized_lines(r) if s)
+    union = set().union(*refs.values())
+
+    rows = []
+    for f in sorted(pathlib.Path(args.package).rglob("*.py")):
+        repo = [s for s in normalized_lines(f) if s]
+        if len(repo) < args.min_lines:
+            continue
+        shared_union = sum(1 for s in repo if s in union)
+        best, best_ref = 0, ""
+        for name, rs in refs.items():
+            sh = sum(1 for s in repo if s in rs)
+            if sh > best:
+                best, best_ref = sh, name
+        rows.append((shared_union / len(repo), f, len(repo), best_ref))
+
+    rows.sort(reverse=True)
+    print(f"{'union%':>7} {'lines':>6}  file  (top single ref)")
+    for pct, f, n, br in rows:
+        print(f"{pct * 100:6.1f}% {n:6d}  {f}  ({br})")
+
+
+if __name__ == "__main__":
+    main()
