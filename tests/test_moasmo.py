@@ -205,3 +205,36 @@ def test_dmosopt_alias_module_and_profiling():
     agg = eval_time_stats([0.5, 1.5, -1.0])
     assert agg["eval_mean"] == pytest.approx(1.0)
     assert eval_time_stats([-1.0])["eval_mean"] == -1.0
+
+
+def test_host_loop_escape_hatch_for_non_scannable_optimizer():
+    """A user-registered optimizer with jit_compatible=False runs through
+    the per-generation host loop (moasmo._optimize_host_loop) with the
+    same result contract as the scan path."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmosopt_tpu.optimizers.nsga2 import NSGA2
+    from dmosopt_tpu.models.gp import GPR_Matern
+    from dmosopt_tpu.models import Model
+
+    class HostNSGA2(NSGA2):
+        jit_compatible = False
+
+    dim, pop = 6, 16
+    rng = np.random.default_rng(0)
+    X = rng.uniform(size=(40, dim)).astype(np.float32)
+    Y = np.asarray(zdt1(jnp.asarray(X)))
+    sm = GPR_Matern(X, Y, dim, 2, np.zeros(dim), np.ones(dim),
+                    seed=0, n_starts=2, n_iter=15)
+    opt = HostNSGA2(popsize=pop, nInput=dim, nOutput=2, model=None)
+    bounds = np.stack([np.zeros(dim), np.ones(dim)], 1)
+    opt.initialize_strategy(X[:pop], Y[:pop], bounds, random=0)
+    eval_fn = moasmo._surrogate_eval_fn(Model(objective=sm))
+
+    x_traj, y_traj, n_gen = moasmo._optimize_on_device(
+        opt, eval_fn, num_generations=4, key=jax.random.PRNGKey(0)
+    )
+    assert n_gen == 4
+    assert x_traj.shape[0] == 4 and x_traj.shape[2] == dim
+    assert np.all(np.isfinite(y_traj))
