@@ -421,22 +421,43 @@ _STRATEGY_PRESETS: Dict[str, Dict] = {
 }
 
 
+_RESOURCE_KEYS = (
+    "max_time_seconds", "max_function_evals", "target_quality_threshold",
+)
+
+
 def create_adaptive_termination(
     problem, n_max_gen: int = 2000, strategy: str = "comprehensive", **kwargs
 ) -> Termination:
     """Factory with the reference's strategy menu
     (adaptive_termination.py:531-612): comprehensive | fast |
     conservative build the composite from a preset; simple is the plain
-    hypervolume-progress criterion."""
+    hypervolume-progress criterion. Resource-budget keys
+    (``max_time_seconds`` / ``max_function_evals`` /
+    ``target_quality_threshold``) attach a ``ResourceAwareTermination``
+    alongside whichever strategy is chosen."""
+    budgets = {
+        k: kwargs.pop(k) for k in _RESOURCE_KEYS if k in kwargs
+    }
+    budgets = {k: v for k, v in budgets.items() if v is not None}
+
     if strategy == "simple":
-        return HypervolumeProgressTermination(
+        term: Termination = HypervolumeProgressTermination(
             problem=problem, n_last=20, nth_gen=5, n_max_gen=n_max_gen, **kwargs
         )
-    preset = _STRATEGY_PRESETS.get(strategy)
-    if preset is None:
-        raise ValueError(
-            f"Unknown strategy {strategy!r}. Choose from: "
-            f"{', '.join([*_STRATEGY_PRESETS, 'simple'])}"
+    else:
+        preset = _STRATEGY_PRESETS.get(strategy)
+        if preset is None:
+            raise ValueError(
+                f"Unknown strategy {strategy!r}. Choose from: "
+                f"{', '.join([*_STRATEGY_PRESETS, 'simple'])}"
+            )
+        merged = {**preset, **kwargs}
+        term = CompositeAdaptiveTermination(
+            problem, n_max_gen=n_max_gen, **merged
         )
-    merged = {**preset, **kwargs}
-    return CompositeAdaptiveTermination(problem, n_max_gen=n_max_gen, **merged)
+    if budgets:
+        term = TerminationCollection(
+            problem, term, ResourceAwareTermination(problem, **budgets)
+        )
+    return term

@@ -426,17 +426,25 @@ class DistOptimizer:
         )
         spec = self._strategy_spec()
         any_restored = False
+        initial_complete = False
         for problem_id in self.problem_ids:
             initial = self._restored_initial(problem_id)
-            if initial is not None and initial[1].shape[0] >= (
-                self.n_initial * len(self.param_names)
-            ):
-                self.start_epoch += 1
+            initial_complete = initial_complete or (
+                initial is not None
+                and initial[1].shape[0]
+                >= self.n_initial * len(self.param_names)
+            )
             any_restored = any_restored or initial is not None
             self.optimizer_dict[problem_id] = DistOptStrategy(
                 opt_prob, n_initial=self.n_initial, initial=initial, **spec
             )
             self.storage_dict[problem_id] = []
+        if initial_complete:
+            # a completed initial design means the restored max epoch is
+            # done: new epochs continue AFTER it. One increment for the
+            # whole run — not one per problem (problems share epoch
+            # numbering; per-problem increments left gaps in the labels)
+            self.start_epoch += 1
         if any_restored:
             self.print_best()
 

@@ -203,3 +203,9 @@ def test_multiproblem_constrained_resume(tmp_path):
             D = cdist(P, P)
             np.fill_diagonal(D, np.inf)
             assert D.min() > 1e-12, f"re-evaluated stored point, pid={pid}"
+            # the resume advances the epoch labels by exactly the resumed
+            # run's epoch count (regression: start_epoch used to advance
+            # once PER RESTORED PROBLEM, compounding gaps — 2 problems
+            # gave [0, 1, 4] instead of [0, 1, 3])
+            ep = np.unique(np.asarray(f["mpres"][pid]["epochs"]))
+            assert list(ep) == [0, 1, 3], ep

@@ -23,9 +23,14 @@ def main():
     ap.add_argument("--min-lines", type=int, default=30)
     args = ap.parse_args()
 
+    ref_root = pathlib.Path(args.ref_dir)
     refs = {}
-    for r in pathlib.Path(args.ref_dir).rglob("*.py"):
-        refs[r.name] = set(s for s in normalized_lines(r) if s)
+    for r in ref_root.rglob("*.py"):
+        # key by relative path: same-named files in different
+        # subdirectories must not clobber each other
+        refs[str(r.relative_to(ref_root))] = set(
+            s for s in normalized_lines(r) if s
+        )
     union = set().union(*refs.values())
 
     rows = []
