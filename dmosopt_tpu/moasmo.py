@@ -120,6 +120,22 @@ def _surrogate_eval_fn(mdl: Model):
     return eval_fn
 
 
+def offspring_per_generation(optimizer) -> int:
+    """Offspring batch size of one generation — static but
+    optimizer-specific (CMA-ES emits mu = pop/2, SMPSO two batches per
+    swarm); traced via the abstract shape without running a generation."""
+    return max(
+        1,
+        int(
+            jax.eval_shape(
+                lambda k, s: optimizer.generate_strategy(k, s)[0],
+                jax.ShapeDtypeStruct((2,), jnp.uint32),
+                optimizer.state,
+            ).shape[0]
+        ),
+    )
+
+
 def _optimize_on_device(
     optimizer,
     eval_fn,
@@ -200,18 +216,7 @@ def _optimize_on_device(
     x_chunks, y_chunks = [], []
     gen = 0
     n_eval = 0
-    # offspring per generation is static but optimizer-specific (SMPSO
-    # emits per-swarm batches); trace it without running a generation
-    noff = max(
-        1,
-        int(
-            jax.eval_shape(
-                lambda k, s: optimizer.generate_strategy(k, s)[0],
-                jax.ShapeDtypeStruct((2,), jnp.uint32),
-                optimizer.state,
-            ).shape[0]
-        ),
-    )
+    noff = offspring_per_generation(optimizer)
     eval_budget = getattr(termination, "eval_budget", lambda: None)()
 
     def terminated():
