@@ -227,9 +227,17 @@ def _optimize_on_device(
     while not terminated():
         n = termination_check_interval
         if eval_budget is not None:
-            # clamp the chunk so the budget stops at the requested count
-            # (evaluations come in whole generations of noff offspring)
-            n = min(n, max(1, -((n_eval - eval_budget) // noff)))
+            # the budget is a hard cap: run only whole generations that
+            # fit under it; when none fits, stop short rather than over
+            n = min(n, (eval_budget - n_eval) // noff)
+            if n <= 0:
+                if logger is not None:
+                    logger.info(
+                        f"{optimizer.name}: evaluation budget "
+                        f"({eval_budget}) leaves no room for a full "
+                        f"generation of {noff}; stopping at {n_eval}"
+                    )
+                break
         key, k = jax.random.split(key)
         keys = jax.random.split(k, n)
         state, (x_traj, y_traj) = run_chunk(optimizer.state, keys)

@@ -13,6 +13,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 from typing import List, Tuple
 
 
@@ -60,15 +61,23 @@ def launch_loopback_cluster(
         )
         for pid in range(n_processes)
     ]
-    results: List[Tuple[int, str]] = []
+    results: dict = {}
+    deadline = time.time() + timeout
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            results.append((p.returncode, out))
+        for i, p in enumerate(procs):
+            # one shared deadline for the whole cluster, not per rank
+            out, _ = p.communicate(timeout=max(0.1, deadline - time.time()))
+            results[i] = (p.returncode, out)
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        for p in procs:
+        # collect only the ranks that had not completed; completed ranks
+        # keep their real output (no duplicates, no re-communicate)
+        for i, p in enumerate(procs):
+            if i in results:
+                continue
             out, _ = p.communicate()
-            results.append((p.returncode, f"[TIMEOUT after {timeout}s]\n{out}"))
-    return results
+            results[i] = (
+                p.returncode, f"[TIMEOUT after {timeout}s]\n{out}"
+            )
+    return [results[i] for i in range(n_processes)]

@@ -146,6 +146,15 @@ class SlidingWindowTermination(TerminationCollection):
         generations; None to skip this generation."""
         return opt
 
+    def stop_reasons(self):
+        # the collection reports member criteria (the generation cap);
+        # when the window criterion itself fired, report THIS class —
+        # otherwise HV-progress/tolerance stops read as unexplained
+        member = super().stop_reasons()
+        if member:
+            return member
+        return [type(self).__name__] if self.stopped else []
+
     @abstractmethod
     def _compare(self, previous, current):  # pragma: no cover
         ...

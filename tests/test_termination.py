@@ -248,3 +248,42 @@ def test_termination_in_moasmo_surrogate_loop():
         next(gen)
     res = ex.value.value
     assert res["x_resample"].shape[0] == 8
+
+
+def test_resource_aware_eval_budget_never_overshoots():
+    """A budget that is NOT a multiple of the offspring count is a hard
+    cap: the loop runs only whole generations that fit under it."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmosopt_tpu import moasmo
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+    from dmosopt_tpu.optimizers import NSGA2
+
+    pop = 16
+    budget = 4 * pop + 6  # 70: only 4 full generations fit
+    opt = NSGA2(popsize=pop, nInput=4, nOutput=2, model=None)
+    rng = np.random.default_rng(12)
+    x0 = rng.uniform(size=(pop, 4)).astype(np.float32)
+    y0 = np.asarray(zdt1(jnp.asarray(x0)))
+    bounds = np.stack([np.zeros(4), np.ones(4)], 1).astype(np.float32)
+    opt.initialize_strategy(x0, y0, bounds, random=1)
+
+    t = ResourceAwareTermination(Prob(), max_function_evals=budget)
+    x_traj, _, n_gen = moasmo._optimize_on_device(
+        opt, zdt1, 100, jax.random.PRNGKey(0),
+        termination=t, termination_check_interval=50,
+    )
+    n_eval = x_traj.shape[0] * x_traj.shape[1]
+    assert n_eval == 4 * pop, (n_eval, budget)
+    assert n_gen == 4
+
+    # budget smaller than one generation: zero evaluations, not one over
+    opt2 = NSGA2(popsize=pop, nInput=4, nOutput=2, model=None)
+    opt2.initialize_strategy(x0, y0, bounds, random=1)
+    t2 = ResourceAwareTermination(Prob(), max_function_evals=pop - 1)
+    x_traj2, _, n_gen2 = moasmo._optimize_on_device(
+        opt2, zdt1, 100, jax.random.PRNGKey(0),
+        termination=t2, termination_check_interval=50,
+    )
+    assert n_gen2 == 0 and x_traj2.shape[0] == 0
