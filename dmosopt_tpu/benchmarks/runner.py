@@ -125,14 +125,12 @@ class BenchmarkRunner:
                 hv_trajectory.append(0.0)
                 continue
             if hv_engine is None:
-                # nadir-anchored reference point, fixed across the run so
-                # the trajectory is comparable epoch to epoch; the margin
-                # is a fraction of the objective SPAN so non-positive
-                # objective values still place the point beyond the nadir
-                nadir = np.max(y, axis=0)
-                span = nadir - np.min(y, axis=0)
-                margin = np.where(span > 0, span, np.abs(nadir) + 1.0)
-                ref = nadir + 0.1 * margin + 1e-9
+                # nadir-anchored, span-margined reference point, fixed
+                # across the run so the trajectory is comparable epoch to
+                # epoch (valid for objectives of any sign)
+                from dmosopt_tpu.hv import default_reference_point
+
+                ref = default_reference_point(y)
                 hv_engine = AdaptiveHyperVolume(ref, epsilon=hv_epsilon)
             hv_trajectory.append(float(hv_engine.compute_hypervolume(y)))
         elapsed = time.time() - t0

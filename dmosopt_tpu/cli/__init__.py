@@ -56,12 +56,22 @@ def _stack_evals(entries):
                    "first given is the primary key)")
 @click.option("--filter-objectives", type=str, default=None,
               help="comma-separated subset of objectives")
+@click.option("--epsilons", type=str, default=None,
+              help='epsilon-box archive instead of the exact front: a '
+                   'number (all objectives), comma-separated per-objective '
+                   'values, or "auto" (0.05 IQR per objective)')
+@click.option("--hv/--no-hv", "with_hv", default=False,
+              help="report the archive hypervolume (adaptive exact/FPRAS)")
+@click.option("--hv-ref", type=str, default=None,
+              help="comma-separated HV reference point (default: nadir + "
+                   "10%% of the span)")
 @click.option("--output-file", type=click.Path(), default=None)
 @click.option("--verbose", "-v", is_flag=True)
 def analyze(file_path, opt_id, constraints, knn, sort_key, filter_objectives,
-            output_file, verbose):
+            epsilons, with_hv, hv_ref, output_file, verbose):
     """Extract and rank the non-dominated set from a results store
-    (intent of reference dmosopt_analyze.py)."""
+    (intent of reference dmosopt_analyze.py, plus epsilon-box archives
+    and hypervolume reporting)."""
     raw, problem_ids = _load(file_path, opt_id)
     objective_names = raw["objective_names"]
     param_names = raw["parameter_names"]
@@ -79,6 +89,14 @@ def analyze(file_path, opt_id, constraints, knn, sort_key, filter_objectives,
         raise click.ClickException(
             f"unknown sort key(s) {missing}; objectives: {names}"
         )
+    eps_arg = None
+    if epsilons is not None:
+        if epsilons == "auto":
+            eps_arg = "auto"
+        elif "," in epsilons:
+            eps_arg = [float(v) for v in epsilons.split(",")]
+        else:
+            eps_arg = float(epsilons)
 
     out = {}
     for problem_id in problem_ids:
@@ -91,11 +109,42 @@ def analyze(file_path, opt_id, constraints, knn, sort_key, filter_objectives,
             y = y[:, keep]
 
         click.echo(f"Found {x.shape[0]} results for id {problem_id}")
-        best_x, best_y, best_f, best_c, best_epoch, _ = moasmo.get_best(
-            x, y, f, c, x.shape[1], y.shape[1], epochs=epochs,
-            feasible=constraints,
-        )
+        if isinstance(eps_arg, list) and len(eps_arg) != y.shape[1]:
+            raise click.ClickException(
+                f"--epsilons needs {y.shape[1]} values (one per displayed "
+                f"objective), got {len(eps_arg)}"
+            )
+        if eps_arg is not None:
+            best_x, best_y, best_f, best_c, eps_used = moasmo.epsilon_get_best(
+                x, y, f, c, feasible=constraints, epsilons=eps_arg,
+            )
+            best_epoch = None
+            click.echo(f"epsilon boxes: {np.round(eps_used, 6).tolist()}")
+        else:
+            best_x, best_y, best_f, best_c, best_epoch, _ = moasmo.get_best(
+                x, y, f, c, x.shape[1], y.shape[1], epochs=epochs,
+                feasible=constraints,
+            )
         click.echo(f"Found {best_x.shape[0]} best results for id {problem_id}")
+
+        hv_value = None
+        if with_hv and best_y.shape[0] > 0:
+            from dmosopt_tpu.hv import AdaptiveHyperVolume, default_reference_point
+
+            if hv_ref is not None:
+                ref = np.asarray([float(v) for v in hv_ref.split(",")])
+                if ref.shape[0] != best_y.shape[1]:
+                    raise click.ClickException(
+                        f"--hv-ref needs {best_y.shape[1]} values"
+                    )
+            else:
+                ref = default_reference_point(best_y)
+            engine = AdaptiveHyperVolume(ref)
+            hv_value = float(engine.compute_hypervolume(best_y))
+            click.echo(
+                f"hypervolume ({engine.last_method}, ref "
+                f"{np.round(ref, 4).tolist()}): {hv_value:.6g}"
+            )
 
         order = np.arange(best_y.shape[0])
         if knn > 0 and best_y.shape[0] > 0:
@@ -130,7 +179,11 @@ def analyze(file_path, opt_id, constraints, knn, sort_key, filter_objectives,
             rows[int(i)] = row
             if verbose or output_file is None:
                 click.echo(f"{i}: {row['objectives']} @ {row['parameters']}")
-        out[str(problem_id)] = rows
+        # with --hv the shape is stable for every problem (hypervolume may
+        # be null when the best set is empty); without it, bare rows
+        out[str(problem_id)] = (
+            {"hypervolume": hv_value, "rows": rows} if with_hv else rows
+        )
 
     if output_file is not None:
         with open(output_file, "w") as fh:

@@ -637,6 +637,18 @@ class HyperVolumeBoxDecomposition:
 # ------------------------------------------------------------------ facade
 
 
+def default_reference_point(Y) -> np.ndarray:
+    """Nadir-anchored reference point with a span-proportional margin:
+    ``nadir + 0.1 * span`` (falling back to ``|nadir| + 1`` per
+    degenerate axis), valid for objectives of any sign. Shared by the
+    benchmark runner and the analyze CLI so their hypervolumes agree."""
+    Y = np.asarray(Y)
+    nadir = Y.max(axis=0)
+    span = nadir - Y.min(axis=0)
+    margin = np.where(span > 0, span, np.abs(nadir) + 1.0)
+    return nadir + 0.1 * margin + 1e-9
+
+
 class AdaptiveHyperVolume:
     """Routing facade (reference: dmosopt/hv.py:77-189 plus the
     hv_adaptive.py estimator family): exact computation for low

@@ -231,6 +231,11 @@ def _optimize_on_device(
             # fit under it; when none fits, stop short rather than over
             n = min(n, (eval_budget - n_eval) // noff)
             if n <= 0:
+                # no evaluation will reach the cap, so the criterion
+                # can't trip on its own — attribute the stop to it
+                from dmosopt_tpu.termination import mark_eval_budget_stop
+
+                mark_eval_budget_stop(termination)
                 if logger is not None:
                     logger.info(
                         f"{optimizer.name}: evaluation budget "
@@ -247,8 +252,10 @@ def _optimize_on_device(
         n_eval += n * x_traj.shape[1]
         optimizer.state = state
     if logger is not None:
+        reasons = getattr(termination, "stop_reasons", lambda: [])()
         logger.info(
-            f"{optimizer.name}: terminated by criterion at generation {gen}"
+            f"{optimizer.name}: stopped at generation {gen}"
+            + (f" ({'+'.join(reasons)})" if reasons else "")
         )
     if not x_chunks:
         # probe eval_fn for the objective-column count (2x nOutput in

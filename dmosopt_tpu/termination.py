@@ -68,6 +68,23 @@ class Termination:
         return [type(self).__name__] if self.stopped else []
 
 
+def mark_eval_budget_stop(term) -> bool:
+    """Mark the criterion owning an evaluation budget as fired. Used by
+    the optimize loops when the remaining budget cannot fit one more full
+    generation: no evaluation ever reaches the cap, so the criterion
+    would otherwise never trip and the stop would go unattributed.
+    Returns True when an owner was found."""
+    if term is None:
+        return False
+    members = getattr(term, "terminations", None)
+    if members is not None:
+        return any([mark_eval_budget_stop(m) for m in members])
+    if getattr(term, "max_function_evals", None) is not None:
+        term.stopped = True
+        return True
+    return False
+
+
 class TerminationCollection(Termination):
     """Terminate when ANY member terminates (reference termination.py:61-69)."""
 

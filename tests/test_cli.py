@@ -111,3 +111,36 @@ def test_analyze_sort_key(store, tmp_path):
     )
     assert bad.exit_code != 0
     assert "unknown sort key" in bad.output
+
+
+def test_analyze_epsilon_and_hv(store, tmp_path):
+    out = tmp_path / "eps.json"
+    result = CliRunner().invoke(
+        analyze,
+        ["-p", store, "--opt-id", "cli_run", "--epsilons", "0.05",
+         "--hv", "--output-file", str(out)],
+    )
+    assert result.exit_code == 0, result.output
+    assert "epsilon boxes" in result.output
+    assert "hypervolume" in result.output
+    payload = json.loads(out.read_text())["0"]
+    assert payload["hypervolume"] > 0
+    assert len(payload["rows"]) >= 1
+
+    # explicit reference point and per-objective epsilons
+    result = CliRunner().invoke(
+        analyze,
+        ["-p", store, "--opt-id", "cli_run", "--epsilons", "0.05,0.1",
+         "--hv", "--hv-ref", "2,2"],
+    )
+    assert result.exit_code == 0, result.output
+
+    bad = CliRunner().invoke(
+        analyze, ["-p", store, "--opt-id", "cli_run", "--hv", "--hv-ref", "2"]
+    )
+    assert bad.exit_code != 0 and "--hv-ref needs" in bad.output
+
+    bad = CliRunner().invoke(
+        analyze, ["-p", store, "--opt-id", "cli_run", "--epsilons", "1,2,3"]
+    )
+    assert bad.exit_code != 0 and "--epsilons needs" in bad.output

@@ -277,6 +277,9 @@ def test_resource_aware_eval_budget_never_overshoots():
     n_eval = x_traj.shape[0] * x_traj.shape[1]
     assert n_eval == 4 * pop, (n_eval, budget)
     assert n_gen == 4
+    # the stop is attributed to the budget criterion even though no
+    # evaluation ever reached the cap
+    assert t.stop_reasons() == ["ResourceAwareTermination"]
 
     # budget smaller than one generation: zero evaluations, not one over
     opt2 = NSGA2(popsize=pop, nInput=4, nOutput=2, model=None)
