@@ -449,15 +449,14 @@ def xinit(
         return None
 
     if isinstance(method, dict):
+        # explicit per-parameter sample columns, validated against bounds
         Xinit = np.column_stack([method[k] for k in param_names])
-        for i in range(Xinit.shape[1]):
-            in_bounds = np.all(
-                np.logical_and(Xinit[:, i] <= xub[i], Xinit[:, i] >= xlb[i])
+        inside = (Xinit >= xlb) & (Xinit <= xub)
+        if not inside.all():
+            bad = [param_names[i] for i in np.nonzero(~inside.all(axis=0))[0]]
+            raise ValueError(
+                f"xinit: out of bounds values for parameter(s) {bad}"
             )
-            if not in_bounds:
-                raise ValueError(
-                    f"xinit: out of bounds values for parameter {param_names[i]}"
-                )
         return Xinit
 
     if logger is not None:
@@ -592,10 +591,8 @@ def train(
 
 def analyze_sensitivity(
     sm,
-    xlb,
-    xub,
-    param_names,
-    objective_names,
+    xlb, xub,
+    param_names, objective_names,
     sensitivity_method_name=None,
     sensitivity_method_kwargs: Optional[Dict[str, Any]] = None,
     di_min: float = 1.0,
