@@ -1,10 +1,10 @@
 """Mesh-sharded MO-ASMO: population-parallel EA + model-parallel GP fit.
 
-Runs anywhere: with fewer real devices than requested, it forces an
-8-device virtual CPU platform (the same mechanism the test suite and
-the multichip dryrun use), so the sharded program compiles and executes
-without TPU hardware. On a real TPU slice, drop the env override and
-the same code runs over ICI.
+Runs anywhere: by default it forces an 8-device virtual CPU platform
+(the same mechanism the test suite and the multichip dryrun use), so
+the sharded program compiles and executes without TPU hardware. On a
+real multi-chip slice, set `USE_REAL_DEVICES=1` to skip the override
+and run the same code over ICI.
 
 For multi-host pods, call
 `dmosopt_tpu.parallel.mesh.initialize_distributed(coordinator, n, pid)`
@@ -14,7 +14,11 @@ first on every host and build the same mesh — see docs/parallel.md.
 import os
 import sys
 
-if __name__ == "__main__" and os.environ.get("_SHARDED_CHILD") != "1":
+if (
+    __name__ == "__main__"
+    and os.environ.get("_SHARDED_CHILD") != "1"
+    and os.environ.get("USE_REAL_DEVICES") != "1"
+):
     # self-provision 8 virtual devices before jax imports anywhere
     env = dict(os.environ, _SHARDED_CHILD="1", JAX_PLATFORMS="cpu")
     flags = " ".join(
