@@ -87,76 +87,83 @@ class AdaptiveEarlyStopping:
             return False, ""
 
         loss_history = np.asarray(loss_history)
-        checks = [
-            self._check_percentage_change(loss_history),
-            self._check_absolute_convergence(loss_history),
-            self._check_relative_convergence(loss_history),
-            self._check_plateau(loss_history),
+        # each criterion yields a firing message or None
+        fired = [
+            msg
+            for check in (
+                self._check_percentage_change,
+                self._check_absolute_convergence,
+                self._check_relative_convergence,
+                self._check_plateau,
+            )
+            if (msg := check(loss_history)) is not None
         ]
         if compute_validation is not None:
-            checks.append(self._check_validation_loss(compute_validation))
+            msg = self._check_validation_loss(compute_validation)
+            if msg is not None:
+                fired.append(msg)
 
-        criteria_met = sum(stop for stop, _ in checks)
-        if criteria_met >= 2:  # at least 2 criteria must agree
+        if len(fired) >= 2:  # at least 2 criteria must agree
             self.patience_counter += 1
             if self.patience_counter >= self.config.patience:
-                return True, "; ".join(r for stop, r in checks if stop and r)
+                return True, "; ".join(fired)
         else:
             self.patience_counter = 0
         return False, ""
 
+    # each _check_* returns a message when its criterion fires, else None
+
     def _check_percentage_change(self, h):
         if len(h) < self.config.window_size + 1:
-            return False, ""
+            return None
         window = h[-self.config.window_size :]
         denom = np.maximum(np.abs(window[:-1]), self.config.absolute_tolerance)
         mean_pct = float(np.mean(np.abs(np.diff(window) / denom)) * 100)
-        if mean_pct < self.config.threshold_pct:
-            return True, f"Mean % change ({mean_pct:.4f}%) < threshold"
-        return False, ""
+        if mean_pct >= self.config.threshold_pct:
+            return None
+        return f"Mean % change ({mean_pct:.4f}%) < threshold"
 
     def _check_absolute_convergence(self, h):
         if len(h) < self.config.window_size:
-            return False, ""
-        window = h[-self.config.window_size :]
-        max_abs = float(np.max(np.abs(np.diff(window))))
-        if max_abs < self.config.absolute_tolerance:
-            return True, f"Max absolute change ({max_abs:.2e}) converged"
-        return False, ""
+            return None
+        max_abs = float(np.max(np.abs(np.diff(h[-self.config.window_size :]))))
+        if max_abs >= self.config.absolute_tolerance:
+            return None
+        return f"Max absolute change ({max_abs:.2e}) converged"
 
     def _check_relative_convergence(self, h):
         if len(h) < self.config.window_size:
-            return False, ""
+            return None
         window = h[-self.config.window_size :]
         if abs(window[0]) < self.config.absolute_tolerance:
-            return False, ""
+            return None
         rel = abs((window[-1] - window[0]) / window[0])
-        if rel < self.config.relative_tolerance:
-            return True, f"Relative change ({rel:.2e}) converged"
-        return False, ""
+        if rel >= self.config.relative_tolerance:
+            return None
+        return f"Relative change ({rel:.2e}) converged"
 
     def _check_plateau(self, h):
         if len(h) < self.config.window_size * 2:
-            return False, ""
+            return None
         mid = len(h) - self.config.window_size
         first = h[mid : mid + self.config.window_size // 2]
         second = h[-self.config.window_size // 2 :]
         mean_diff = abs(np.mean(first) - np.mean(second))
         mean_value = np.mean(h[-self.config.window_size :])
         rel = mean_diff / (abs(mean_value) + self.config.absolute_tolerance)
-        if rel < self.config.relative_tolerance * 2:
-            return True, f"Loss plateau detected (relative difference: {rel:.2e})"
-        return False, ""
+        if rel >= self.config.relative_tolerance * 2:
+            return None
+        return f"Loss plateau detected (relative difference: {rel:.2e})"
 
     def _check_validation_loss(self, compute_validation):
         try:
             val = compute_validation()
         except Exception:
-            return False, ""
+            return None
         if val < self.best_loss - self.config.absolute_tolerance:
             self.best_loss = val
-            return False, ""
-        return True, f"No validation improvement (best: {self.best_loss:.4f})"
+            return None
+        return f"No validation improvement (best: {self.best_loss:.4f})"
 
 
 def analyze_loss_trajectory(loss_history: np.ndarray) -> dict:
