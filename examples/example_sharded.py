@@ -46,9 +46,18 @@ def zdt1_batch(X):
 
 
 if __name__ == "__main__":
-    # population axis (4-way) for the EA loop and batch evaluation;
-    # model axis (2-way) for the GP fit's multi-start dimension
-    mesh = create_mesh(8, axis_names=("pop", "model"), shape=(4, 2))
+    import jax
+
+    # population axis for the EA loop and batch evaluation; model axis
+    # (2-way when the device count allows) for the GP fit's multi-start
+    # dimension — shaped from however many devices are actually present
+    n_dev = len(jax.devices())
+    if n_dev >= 4 and n_dev % 2 == 0:
+        mesh = create_mesh(
+            n_dev, axis_names=("pop", "model"), shape=(n_dev // 2, 2)
+        )
+    else:
+        mesh = create_mesh(n_dev, axis_names=("pop",))
 
     best = dmosopt_tpu.run({
         "opt_id": "sharded_zdt1",
