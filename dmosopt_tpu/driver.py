@@ -266,11 +266,27 @@ class DistOptimizer:
         self.epoch_count = self.saved_eval_count = self.eval_count = 0
         self.optimizer_dict, self.storage_dict, self.stats = {}, {}, {}
 
-        self.feature_constructor = (
-            import_object_by_path(feature_class)
-            if feature_class is not None
-            else (lambda x: x)
-        )
+        # the archive holds features as flat float columns (see
+        # strategy.complete_request); the constructor rebuilds the
+        # user-facing view at presentation time — a custom feature_class,
+        # or structured records named per feature_dtypes by default
+        if feature_class is not None:
+            self.feature_constructor = import_object_by_path(feature_class)
+        elif self.feature_dtypes is not None:
+            dt = np.dtype([tuple(d) for d in self.feature_dtypes])
+
+            def _to_records(F, _dt=dt):
+                if F is None:
+                    return None
+                from numpy.lib.recfunctions import unstructured_to_structured
+
+                return unstructured_to_structured(
+                    np.asarray(F, np.float64), dtype=_dt
+                )
+
+            self.feature_constructor = _to_records
+        else:
+            self.feature_constructor = lambda x: x
         self.feature_names = (
             [dt[0] for dt in self.feature_dtypes]
             if self.feature_dtypes is not None
@@ -392,10 +408,14 @@ class DistOptimizer:
         y = np.vstack([e.objectives for e in evals])
         f = None
         if self.feature_dtypes is not None:
-            # stored features may be scalar records, flat rows, or shaped
-            # rows; normalize each to one row before stacking
-            rows = [np.atleast_1d(np.asarray(e.features)).ravel() for e in evals]
-            f = self.feature_constructor(np.stack(rows, axis=0))
+            # the archive convention is flat float columns (the
+            # constructor is applied at presentation time only, in
+            # get_best_evals — never here, or restored rows would be
+            # constructed twice and mix representations with live rows)
+            from dmosopt_tpu.storage import feature_columns
+
+            rows = [feature_columns(e.features).ravel() for e in evals]
+            f = np.stack(rows, axis=0)
         c = None
         if self.constraint_names is not None:
             c = np.vstack([e.constraints for e in evals])

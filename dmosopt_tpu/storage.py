@@ -76,6 +76,19 @@ def h5_concat_dataset(dset, data):
     return dset
 
 
+def feature_columns(f) -> np.ndarray:
+    """Feature record -> flat float64 columns. Structured (compound-dtype)
+    records — the reference's feature convention, h5_init_types builds
+    compound dtypes for them — flatten to their fields in declaration
+    order; plain arrays cast directly."""
+    arr = np.asarray(f)
+    if arr.dtype.names:
+        from numpy.lib.recfunctions import structured_to_unstructured
+
+        arr = structured_to_unstructured(arr, dtype=np.float64)
+    return np.asarray(arr, dtype=np.float64)
+
+
 # ----------------------------------------------------- space serialization
 
 
@@ -243,7 +256,7 @@ def save_to_h5(
             if f_completed is not None:
                 F = np.vstack(
                     [
-                        np.asarray(f, dtype=np.float64).reshape(1, -1)
+                        feature_columns(f).reshape(1, -1)
                         for f in f_completed
                     ]
                 )

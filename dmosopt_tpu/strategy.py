@@ -194,8 +194,14 @@ class DistOptStrategy:
             if pred.shape[0] == self.prob.n_objectives:
                 # mean-only prediction: pad zero variances alongside
                 pred = np.column_stack((pred, np.zeros_like(pred)))
-        if f is not None and np.ndim(f) == 1:
-            f = np.reshape(f, (1, -1))
+        if f is not None:
+            # archive convention: flat float columns (structured records
+            # flatten to their fields; feature_constructor reconstructs
+            # the user-facing view) — keeps live rows concatenable with
+            # rows restored from storage
+            from dmosopt_tpu.storage import feature_columns
+
+            f = feature_columns(f).reshape(1, -1)
         entry = EvalEntry(epoch, x, y, f, c, pred, time)
         self.completed.append(entry)
         return entry

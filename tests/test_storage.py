@@ -209,3 +209,58 @@ def test_multiproblem_constrained_resume(tmp_path):
             # gave [0, 1, 4] instead of [0, 1, 3])
             ep = np.unique(np.asarray(f["mpres"][pid]["epochs"]))
             assert list(ep) == [0, 1, 3], ep
+
+
+def test_structured_features_save_and_resume(tmp_path):
+    """Compound-dtype feature records (the reference's feature
+    convention) flatten to float columns in storage and stay
+    concatenable across a resume."""
+    import dmosopt_tpu
+    import dmosopt_tpu.driver as drv
+    import h5py
+
+    DIM = 5
+
+    def obj(pp):
+        x = np.array([pp[f"x{i}"] for i in range(DIM)])
+        y = np.array([x[0], 1.0 - x[0] + (x[1:] ** 2).sum()])
+        f = np.array(
+            [(float(x.mean()), float(x.std()))],
+            dtype=[("mean_x", "f8"), ("std_x", "f8")],
+        )
+        return y, f
+
+    fp = str(tmp_path / "feat.h5")
+    params = {
+        "opt_id": "feat",
+        "obj_fun": obj,
+        "objective_names": ["f1", "f2"],
+        "feature_dtypes": [("mean_x", "f8"), ("std_x", "f8")],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(DIM)},
+        "problem_parameters": {},
+        "n_initial": 2,
+        "n_epochs": 2,
+        "population_size": 16,
+        "num_generations": 5,
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 15, "seed": 0},
+        "random_seed": 4,
+        "save": True,
+        "file_path": fp,
+    }
+    best = dmosopt_tpu.run(params, return_features=True, verbose=False)
+    assert len(best[2]) > 0  # feature records returned to the caller
+    # field names survive the flat-column archive via the default
+    # feature constructor built from feature_dtypes
+    assert best[2].dtype.names == ("mean_x", "std_x")
+    n1 = None
+    with h5py.File(fp, "r") as f:
+        n1 = f["feat"]["0"]["features"].shape
+    assert n1[1] == 2
+
+    drv.dopt_dict.clear()
+    dmosopt_tpu.run(params, verbose=False)  # resume must concat cleanly
+    with h5py.File(fp, "r") as f:
+        F = np.asarray(f["feat"]["0"]["features"])
+    assert F.shape[0] > n1[0] and F.shape[1] == 2
+    assert np.isfinite(F).all()
