@@ -278,6 +278,11 @@ class DistOptimizer:
             def _to_records(F, _dt=dt):
                 if F is None:
                     return None
+                F = np.asarray(F)
+                if F.dtype.names:
+                    # already records: non-numeric fields bypass the
+                    # flat-column archive and arrive here unconverted
+                    return F
                 from numpy.lib.recfunctions import unstructured_to_structured
 
                 return unstructured_to_structured(

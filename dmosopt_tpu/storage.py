@@ -80,9 +80,21 @@ def feature_columns(f) -> np.ndarray:
     """Feature record -> flat float64 columns. Structured (compound-dtype)
     records — the reference's feature convention, h5_init_types builds
     compound dtypes for them — flatten to their fields in declaration
-    order; plain arrays cast directly."""
+    order; plain arrays cast directly. Numeric fields only: the archive
+    and the h5 store are float64 columns (raises with the offending
+    field names otherwise)."""
     arr = np.asarray(f)
     if arr.dtype.names:
+        bad = [
+            n
+            for n in arr.dtype.names
+            if not np.issubdtype(arr.dtype[n].base, np.number)
+        ]
+        if bad:
+            raise TypeError(
+                f"feature fields {bad} are not numeric; only numeric "
+                f"feature fields can be archived/persisted"
+            )
         from numpy.lib.recfunctions import structured_to_unstructured
 
         arr = structured_to_unstructured(arr, dtype=np.float64)
@@ -180,7 +192,12 @@ def init_h5(
         _json_attr(
             opt_grp,
             "feature_dtypes",
-            [[dt[0], str(dt[1])] for dt in feature_dtypes]
+            [
+                # canonical dtype string (handles np.float64-style class
+                # specs) plus the subarray shape when one is declared
+                [dt[0], np.dtype(dt[1]).str] + list(dt[2:3])
+                for dt in feature_dtypes
+            ]
             if feature_dtypes is not None
             else None,
         )
@@ -364,7 +381,13 @@ def h5_load_raw(fpath, opt_id):
         out["objective_names"] = _load_json_attr(opt_grp, "objective_names")
         fdt = _load_json_attr(opt_grp, "feature_dtypes")
         out["feature_dtypes"] = (
-            [(name, dtype) for name, dtype in fdt] if fdt is not None else None
+            [
+                # entries are [name, dtype] or [name, dtype, shape]
+                tuple(entry[:2]) + ((tuple(entry[2]),) if len(entry) > 2 else ())
+                for entry in fdt
+            ]
+            if fdt is not None
+            else None
         )
         out["constraint_names"] = _load_json_attr(opt_grp, "constraint_names")
 

@@ -198,10 +198,16 @@ class DistOptStrategy:
             # archive convention: flat float columns (structured records
             # flatten to their fields; feature_constructor reconstructs
             # the user-facing view) — keeps live rows concatenable with
-            # rows restored from storage
+            # rows restored from storage. Records with non-numeric fields
+            # can't be columnized; they pass through raw (memory-only:
+            # persistence rejects them with the field names)
             from dmosopt_tpu.storage import feature_columns
 
-            f = feature_columns(f).reshape(1, -1)
+            try:
+                f = feature_columns(f).reshape(1, -1)
+            except TypeError:
+                if np.ndim(f) == 1:
+                    f = np.reshape(f, (1, -1))
         entry = EvalEntry(epoch, x, y, f, c, pred, time)
         self.completed.append(entry)
         return entry
@@ -431,7 +437,13 @@ class DistOptStrategy:
     def get_evals(self, return_features: bool = False, return_constraints: bool = False):
         out = [self.x, self.y]
         if return_features:
-            out.append(self.f)
+            # same presentation-time construction as get_best_evals: the
+            # archive keeps flat columns, callers see feature records
+            out.append(
+                self.prob.feature_constructor(self.f)
+                if self.f is not None
+                else None
+            )
         if return_constraints:
             out.append(self.c)
         return tuple(out)

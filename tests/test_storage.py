@@ -264,3 +264,44 @@ def test_structured_features_save_and_resume(tmp_path):
         F = np.asarray(f["feat"]["0"]["features"])
     assert F.shape[0] > n1[0] and F.shape[1] == 2
     assert np.isfinite(F).all()
+
+
+def test_subarray_feature_dtype_roundtrip(tmp_path):
+    """Subarray feature fields (name, dtype, shape) and class dtype specs
+    survive the JSON round trip and the resumed constructor."""
+    import dmosopt_tpu
+    import dmosopt_tpu.driver as drv
+
+    DIM = 4
+
+    def obj(pp):
+        x = np.array([pp[f"x{i}"] for i in range(DIM)])
+        f = np.zeros((1,), dtype=[("hist", "f8", (3,)), ("m", np.float64)])
+        f["hist"][0] = x[:3]
+        f["m"][0] = x.mean()
+        return np.array([x[0], 1.0 - x[0]]), f
+
+    fp = str(tmp_path / "subarr.h5")
+    params = {
+        "opt_id": "subarr",
+        "obj_fun": obj,
+        "objective_names": ["f1", "f2"],
+        "feature_dtypes": [("hist", "f8", (3,)), ("m", np.float64)],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(DIM)},
+        "problem_parameters": {},
+        "n_initial": 2,
+        "n_epochs": 2,
+        "population_size": 16,
+        "num_generations": 5,
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 15, "seed": 0},
+        "random_seed": 4,
+        "save": True,
+        "file_path": fp,
+    }
+    best = dmosopt_tpu.run(params, return_features=True, verbose=False)
+    assert best[2]["hist"].shape[1:] == (3,)
+    drv.dopt_dict.clear()
+    dmosopt_tpu.run(params, verbose=False)  # resume: dtype reconstructed
+    raw = storage.h5_load_raw(fp, "subarr")
+    assert raw["feature_dtypes"] == [("hist", "<f8", (3,)), ("m", "<f8")]
