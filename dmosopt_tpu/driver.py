@@ -269,19 +269,35 @@ class DistOptimizer:
         # the archive holds features as flat float columns (see
         # strategy.complete_request); the constructor rebuilds the
         # user-facing view at presentation time — a custom feature_class,
-        # or structured records named per feature_dtypes by default
+        # or structured records named per feature_dtypes by default.
+        # persist_features makes the strategy fail fast on features that
+        # can't be columnized (the h5 store is flat float64 columns)
+        self.persist_features = bool(self.save)
+        dt = dt_numeric = None
+        if self.feature_dtypes is not None:
+            from dmosopt_tpu.storage import non_numeric_feature_fields
+
+            dt = np.dtype([tuple(d) for d in self.feature_dtypes])
+            bad = non_numeric_feature_fields(dt)
+            dt_numeric = not bad
+            if self.save and bad:
+                # fail at init, not after a whole epoch of evaluations
+                raise ValueError(
+                    f"feature fields {bad} are not numeric; persistence "
+                    f"(save=True) requires numeric feature dtypes"
+                )
         if feature_class is not None:
             self.feature_constructor = import_object_by_path(feature_class)
-        elif self.feature_dtypes is not None:
-            dt = np.dtype([tuple(d) for d in self.feature_dtypes])
+        elif dt is not None:
 
-            def _to_records(F, _dt=dt):
+            def _to_records(F, _dt=dt, _numeric=dt_numeric):
                 if F is None:
                     return None
                 F = np.asarray(F)
-                if F.dtype.names:
-                    # already records: non-numeric fields bypass the
-                    # flat-column archive and arrive here unconverted
+                if F.dtype.names or not _numeric:
+                    # already records, or a non-numeric feature spec:
+                    # such features bypass the flat-column archive and
+                    # arrive here unconverted — present them as-is
                     return F
                 from numpy.lib.recfunctions import unstructured_to_structured
 
@@ -437,6 +453,7 @@ class DistOptimizer:
         "feasibility_method_name", "feasibility_method_kwargs",
         "termination_conditions", "optimize_mean_variance",
         "local_random", "logger", "file_path", "mesh",
+        "persist_features",
     )
 
     def _strategy_spec(self):

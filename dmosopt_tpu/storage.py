@@ -76,25 +76,43 @@ def h5_concat_dataset(dset, data):
     return dset
 
 
+def _column_safe(dtype) -> bool:
+    """Dtypes that cast losslessly to the float64 column archive.
+    Complex is excluded (the cast would silently drop the imaginary
+    part), as is timedelta64 (a np.number subtype whose unit would be
+    discarded)."""
+    if np.issubdtype(dtype, np.complexfloating) or np.issubdtype(
+        dtype, np.timedelta64
+    ):
+        return False
+    return np.issubdtype(dtype, np.number) or np.issubdtype(dtype, np.bool_)
+
+
+def non_numeric_feature_fields(dtype) -> list:
+    """Field names of a structured dtype that cannot be archived as
+    float64 columns (empty list for a plain dtype that can)."""
+    if dtype.names:
+        return [n for n in dtype.names if not _column_safe(dtype[n].base)]
+    return [] if _column_safe(dtype) else [str(dtype)]
+
+
 def feature_columns(f) -> np.ndarray:
     """Feature record -> flat float64 columns. Structured (compound-dtype)
     records — the reference's feature convention, h5_init_types builds
     compound dtypes for them — flatten to their fields in declaration
     order; plain arrays cast directly. Numeric fields only: the archive
     and the h5 store are float64 columns (raises with the offending
-    field names otherwise)."""
+    field names otherwise). The decision is by dtype, not castability:
+    a string array like ["12"] would cast to float silently and corrupt
+    the archive."""
     arr = np.asarray(f)
+    bad = non_numeric_feature_fields(arr.dtype)
+    if bad:
+        raise TypeError(
+            f"feature fields {bad} are not numeric; only numeric "
+            f"feature fields can be archived/persisted"
+        )
     if arr.dtype.names:
-        bad = [
-            n
-            for n in arr.dtype.names
-            if not np.issubdtype(arr.dtype[n].base, np.number)
-        ]
-        if bad:
-            raise TypeError(
-                f"feature fields {bad} are not numeric; only numeric "
-                f"feature fields can be archived/persisted"
-            )
         from numpy.lib.recfunctions import structured_to_unstructured
 
         arr = structured_to_unstructured(arr, dtype=np.float64)
@@ -157,6 +175,20 @@ def _load_json_attr(grp, name, default=None):
     return default
 
 
+def _feature_dtype_from_json(entry):
+    """JSON entry [name, dtype] or [name, dtype, shape] -> dtype tuple.
+    The shape may be a bare int in stores written before the save-time
+    canonicalization."""
+    if len(entry) <= 2:
+        return tuple(entry[:2])
+    shape = (
+        tuple(entry[2])
+        if isinstance(entry[2], (list, tuple))
+        else (int(entry[2]),)
+    )
+    return (entry[0], entry[1], shape)
+
+
 # ------------------------------------------------------------------- init
 
 
@@ -194,8 +226,15 @@ def init_h5(
             "feature_dtypes",
             [
                 # canonical dtype string (handles np.float64-style class
-                # specs) plus the subarray shape when one is declared
-                [dt[0], np.dtype(dt[1]).str] + list(dt[2:3])
+                # specs) plus the subarray shape when one is declared —
+                # canonicalized to a list so bare-int shapes like
+                # ("hist", "f8", 3) round-trip (numpy accepts both forms)
+                [dt[0], np.dtype(dt[1]).str]
+                + (
+                    [np.atleast_1d(dt[2]).astype(int).tolist()]
+                    if len(dt) > 2
+                    else []
+                )
                 for dt in feature_dtypes
             ]
             if feature_dtypes is not None
@@ -381,11 +420,7 @@ def h5_load_raw(fpath, opt_id):
         out["objective_names"] = _load_json_attr(opt_grp, "objective_names")
         fdt = _load_json_attr(opt_grp, "feature_dtypes")
         out["feature_dtypes"] = (
-            [
-                # entries are [name, dtype] or [name, dtype, shape]
-                tuple(entry[:2]) + ((tuple(entry[2]),) if len(entry) > 2 else ())
-                for entry in fdt
-            ]
+            [_feature_dtype_from_json(entry) for entry in fdt]
             if fdt is not None
             else None
         )

@@ -79,6 +79,7 @@ class DistOptStrategy:
         optimize_mean_variance: bool = False,
         # runtime plumbing
         local_random=None, logger=None, file_path=None, mesh=None,
+        persist_features: bool = False,
     ):
         self.__dict__.update(
             prob=prob,
@@ -92,6 +93,7 @@ class DistOptStrategy:
             surrogate_custom_training_kwargs=surrogate_custom_training_kwargs,
             sensitivity_method_name=sensitivity_method_name,
             optimize_mean_variance=optimize_mean_variance,
+            persist_features=persist_features,
             distance_metric=distance_metric,
             resample_fraction=resample_fraction,
             num_generations=num_generations,
@@ -206,6 +208,13 @@ class DistOptStrategy:
             try:
                 f = feature_columns(f).reshape(1, -1)
             except TypeError:
+                # non-numeric features (structured records with
+                # non-numeric fields, or plain string/object arrays)
+                # pass through raw — feature_columns decides by dtype.
+                # When the run persists, fail HERE on the first such
+                # evaluation, not at save time after a whole epoch
+                if self.persist_features:
+                    raise
                 if np.ndim(f) == 1:
                     f = np.reshape(f, (1, -1))
         entry = EvalEntry(epoch, x, y, f, c, pred, time)

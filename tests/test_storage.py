@@ -305,3 +305,94 @@ def test_subarray_feature_dtype_roundtrip(tmp_path):
     dmosopt_tpu.run(params, verbose=False)  # resume: dtype reconstructed
     raw = storage.h5_load_raw(fp, "subarr")
     assert raw["feature_dtypes"] == [("hist", "<f8", (3,)), ("m", "<f8")]
+
+
+def test_int_subarray_feature_dtype_roundtrip(tmp_path):
+    """A bare-int subarray shape — ("hist", "f8", 3), a form np.dtype
+    accepts — must survive init_h5 -> h5_load_raw; it used to crash the
+    load with TypeError ('int' object is not iterable)."""
+    import json
+
+    fp = str(tmp_path / "intshape.h5")
+    space = ParameterSpace.from_dict({"x0": [0.0, 1.0]})
+    storage.init_h5(
+        "intshape", [0], False, space, ["x0"], ["f1"],
+        [("hist", "f8", 3), ("m", np.float64)], None, None, None, 1, fp,
+    )
+    raw = storage.h5_load_raw(fp, "intshape")
+    assert raw["feature_dtypes"] == [("hist", "<f8", (3,)), ("m", "<f8")]
+    np.dtype(raw["feature_dtypes"])  # numpy accepts the canonical form
+
+    # stores written before the save-time canonicalization carry the raw
+    # int; the load guard must normalize it
+    with h5py.File(fp, "a") as h5:
+        h5["intshape"].attrs["feature_dtypes"] = json.dumps([["hist", "<f8", 3]])
+    raw = storage.h5_load_raw(fp, "intshape")
+    assert raw["feature_dtypes"] == [("hist", "<f8", (3,))]
+
+
+def test_non_numeric_plain_feature_passthrough(tmp_path):
+    """A plain (non-structured) non-numeric feature array must pass
+    through evaluation completion raw instead of crashing the float64
+    cast in feature_columns (memory-only; persistence rejects it)."""
+
+    def obj(pp):
+        x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+        label = np.array(["lo" if x[0] < 0.5 else "hi"])
+        return np.array([x[0], 1.0 - x[0]]), label
+
+    params = {
+        "opt_id": "strfeat",
+        "obj_fun": obj,
+        "objective_names": ["f1", "f2"],
+        "feature_dtypes": [("label", "U8")],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(N_DIM)},
+        "problem_parameters": {},
+        "n_initial": 4,
+        "n_epochs": 1,
+        "population_size": 16,
+        "num_generations": 5,
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 15, "seed": 0},
+        "random_seed": 3,
+    }
+    best = dmosopt_tpu.run(params, return_features=True, verbose=False)
+    assert best is not None
+    # presentation keeps the raw string labels (no float round trip)
+    labels = np.asarray(best[2]).ravel()
+    assert set(np.unique(labels)) <= {"lo", "hi"}
+
+    # numeric-parseable strings must NOT be silently float-ified: the
+    # dtype decides, so feature_columns rejects any non-numeric array
+    with pytest.raises(TypeError, match="not numeric"):
+        storage.feature_columns(np.array(["12", "34"]))
+
+    # with persistence on, non-numeric feature dtypes fail at init —
+    # not at save time after a completed epoch
+    import dmosopt_tpu.driver as drv
+
+    drv.dopt_dict.clear()
+    with pytest.raises(ValueError, match="numeric"):
+        dmosopt_tpu.run(
+            dict(params, save=True,
+                 file_path=str(tmp_path / "strfeat_reject.h5")),
+            verbose=False,
+        )
+
+    # bool features are column-safe (lossless float64 cast) — must not
+    # be caught by the non-numeric gate
+    assert np.allclose(
+        storage.feature_columns(np.array([True, False])), [1.0, 0.0]
+    )
+
+    # complex is NOT column-safe: the cast would silently drop the
+    # imaginary part
+    with pytest.raises(TypeError, match="not numeric"):
+        storage.feature_columns(np.array([1.0 + 2.0j]))
+    with pytest.raises(TypeError, match="not numeric"):
+        storage.feature_columns(np.zeros((1,), dtype=[("z", "c16")]))
+
+    # timedelta64 is a np.number subtype but its unit would be
+    # discarded by the cast — also rejected
+    with pytest.raises(TypeError, match="not numeric"):
+        storage.feature_columns(np.array([1, 2], dtype="m8[us]"))
