@@ -3,7 +3,12 @@ configuration suite (configs 2-5), all measured against the reference
 dmosopt running single-process on this container's CPU.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
-with per-config results under "configs".
+with per-config results under "configs". The line is emitted with rc=0
+even when the accelerator backend is unreachable: `python bench.py`
+runs an orchestrator that probes the default backend in a subprocess
+with a hard timeout, falls back to `JAX_PLATFORMS=cpu` when the probe
+hangs or fails (`"device_mode": "cpu-fallback"`), and salvages partial
+per-config results if the measuring child dies mid-suite.
 
 Reference methodology (BASELINE.md "Measured" tables): the reference ran
 via its own controller-only mode (a faithful distwq stand-in evaluating
@@ -16,11 +21,20 @@ evals included) in wall_sec — the comparison is end-to-end wall.
 
 import json
 import os
+import sys
+import subprocess
 import time
 
-import numpy as np
-import jax
-import jax.numpy as jnp
+_CHILD_FLAG = "_DMOSOPT_TPU_BENCH_CHILD"
+_PARTIAL_ENV = "_DMOSOPT_TPU_BENCH_PARTIAL"
+
+# jax/numpy stay un-imported in the orchestrating process: with a wedged
+# accelerator tunnel even backend discovery can hang, and the
+# orchestrator must outlive that to emit its JSON line
+if os.environ.get(_CHILD_FLAG) or __name__ != "__main__":
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
 
 REFERENCE_CPU_GENS_PER_SEC = 20.38  # reference dmosopt NSGA2, this host CPU
 REFERENCE_CPU_GP_FIT_SEC = 8.12  # reference GPR_Matern + SCE-UA, N=200
@@ -311,45 +325,229 @@ def bench_lorenz_big_pop():
     return out
 
 
-def main():
+def _emit_partial(result):
+    """Checkpoint the in-progress result dict so the orchestrator can
+    salvage it if this measuring process dies or is killed mid-suite."""
+    path = os.environ.get(_PARTIAL_ENV)
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(result, fh)
+    os.replace(tmp, path)
+
+
+def child_main():
+    """The measuring process: assumes a live jax backend (the
+    orchestrator picked it) and runs the full suite, checkpointing after
+    every config."""
     # persist XLA compilations across configs and bench runs — end-to-end
     # wall for the MO-ASMO configs is otherwise compile-dominated on a
-    # cold process (cache dir is gitignored; kept under the repo so it
-    # survives between rounds on the same machine)
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_bench_cache"),
+    # cold process (cache dir is gitignored, machine-keyed so a container
+    # migrating hosts doesn't load mismatched AOT entries)
+    from dmosopt_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_bench_cache")
     )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+    result = {
+        "metric": "zdt1_nsga2_generations_per_sec",
+        "value": 0.0,
+        "unit": "generations/sec (pop=200, dim=30)",
+        "vs_baseline": 0.0,
+        "configs": {},
+        "device": str(jax.devices()[0]),
+    }
+    _emit_partial(result)
+
+    if os.environ.get("DMOSOPT_BENCH_SMOKE"):
+        # pipeline-validation mode for tests: one tiny EA loop proves the
+        # backend + JSON plumbing without the full (many-minute) suite
+        from dmosopt_tpu.optimizers.nsga2 import NSGA2
+        from dmosopt_tpu.optimizers.base import run_ea_loop
+        from dmosopt_tpu.benchmarks.zdt import zdt1
+        from dmosopt_tpu import sampling
+
+        dim, pop, ngen = 6, 16, 5
+        x0 = sampling.lh(pop, dim, 1)
+        y0 = np.asarray(zdt1(jnp.asarray(x0)))
+        opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=None)
+        opt.initialize_strategy(
+            x0, y0, np.stack([np.zeros(dim), np.ones(dim)], 1), random=1
+        )
+        t0 = time.time()
+        st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(2), ngen, zdt1)
+        jax.block_until_ready(st.population_obj)
+        result.update(value=round(ngen / (time.time() - t0), 2), smoke=True)
+        print(json.dumps(result))
+        return
 
     gens_per_sec, gp_fit_sec, on_front = bench_zdt1_nsga2()
-    configs = {}
+    result.update(
+        value=round(gens_per_sec, 2),
+        vs_baseline=round(gens_per_sec / REFERENCE_CPU_GENS_PER_SEC, 2),
+        gp_fit_sec=round(gp_fit_sec, 3),
+        gp_fit_vs_baseline=round(
+            REFERENCE_CPU_GP_FIT_SEC / max(gp_fit_sec, 1e-9), 2
+        ),
+        on_front_of_200=on_front,
+    )
+    _emit_partial(result)
+
     for fn in (bench_zdt_agemoea, bench_tnk, bench_dtlz_many_objective,
                bench_lorenz_big_pop):
         try:
-            configs.update(fn())
+            result["configs"].update(fn())
         except Exception as e:  # a failing config must not lose the line
-            configs[fn.__name__] = {"error": f"{type(e).__name__}: {e}"}
-
-    print(
-        json.dumps(
-            {
-                "metric": "zdt1_nsga2_generations_per_sec",
-                "value": round(gens_per_sec, 2),
-                "unit": "generations/sec (pop=200, dim=30)",
-                "vs_baseline": round(gens_per_sec / REFERENCE_CPU_GENS_PER_SEC, 2),
-                "gp_fit_sec": round(gp_fit_sec, 3),
-                "gp_fit_vs_baseline": round(
-                    REFERENCE_CPU_GP_FIT_SEC / max(gp_fit_sec, 1e-9), 2
-                ),
-                "on_front_of_200": on_front,
-                "configs": configs,
-                "device": str(jax.devices()[0]),
+            result["configs"][fn.__name__] = {
+                "error": f"{type(e).__name__}: {e}"
             }
+        _emit_partial(result)
+
+    print(json.dumps(result))
+
+
+# ------------------------------------------------------- orchestration
+#
+# `python bench.py` must produce its JSON line even when the accelerator
+# tunnel is wedged (a failure mode this container actually exhibits: the
+# axon plugin hangs interpreter-level backend init for hours). Nothing
+# below imports jax.
+
+
+def _probe_default_backend(timeout_s):
+    """Ask a subprocess which backend the default env yields. Returns
+    the platform name, or None when the probe fails or hangs — a hung
+    probe is precisely the wedged-tunnel case the orchestrator must
+    survive."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s,
         )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    return None
+
+
+def _cpu_fallback_env():
+    """Env overrides for a CPU-only measuring child. Besides forcing the
+    platform, the accelerator plugin's sitecustomize must come OFF
+    PYTHONPATH: it stalls even CPU-platform processes when the tunnel is
+    wedged (observed: a 16 s smoke run timing out at 600 s)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    keep = [
+        p
+        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in os.path.basename(p)
+    ]
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.pathsep.join([repo] + keep),
+    }
+
+
+def _run_measuring_child(extra_env, timeout_s, partial_path):
+    """Run this script in measuring mode; return (result_dict|None,
+    diagnostic_str). Salvages the partial checkpoint on timeout/crash."""
+    env = dict(os.environ)
+    env[_CHILD_FLAG] = "1"
+    env[_PARTIAL_ENV] = partial_path
+    env.update(extra_env)
+    diag = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout_s,
+        )
+        out, err, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        err = e.stderr or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        rc = "timeout"
+    diag = f"rc={rc}; stderr tail: {err[-1500:]}" if rc != 0 else ""
+    for line in reversed(out.strip().splitlines() or [""]):
+        if line.startswith("{"):
+            try:
+                return json.loads(line), diag
+            except json.JSONDecodeError:
+                break
+    # no final line — salvage the per-config checkpoint
+    if os.path.exists(partial_path):
+        try:
+            with open(partial_path) as fh:
+                result = json.load(fh)
+            result["partial"] = True
+            return result, diag
+        except (OSError, json.JSONDecodeError):
+            pass
+    return None, diag
+
+
+def orchestrate():
+    """Probe, measure (with CPU fallback), and print exactly one JSON
+    line on stdout; always exits 0."""
+    probe_s = float(os.environ.get("DMOSOPT_BENCH_PROBE_TIMEOUT", 120))
+    child_s = float(os.environ.get("DMOSOPT_BENCH_TIMEOUT", 2700))
+    partial = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_partial.json"
     )
+    if os.path.exists(partial):
+        os.remove(partial)
+
+    platform = _probe_default_backend(probe_s)
+    device_mode = "default" if platform else "cpu-fallback"
+    if platform:
+        print(f"bench: default backend is '{platform}'", file=sys.stderr)
+    else:
+        print(
+            f"bench: default backend unreachable within {probe_s:.0f}s; "
+            f"falling back to JAX_PLATFORMS=cpu", file=sys.stderr,
+        )
+
+    extra = {} if platform else _cpu_fallback_env()
+    result, diag = _run_measuring_child(extra, child_s, partial)
+
+    if result is None and platform:
+        # backend probed fine but the suite still died on it (e.g. the
+        # tunnel wedged mid-run) — one retry on the CPU fallback
+        print(
+            f"bench: suite failed on '{platform}' ({diag}); retrying on "
+            f"cpu", file=sys.stderr,
+        )
+        device_mode = "cpu-fallback"
+        result, diag = _run_measuring_child(
+            _cpu_fallback_env(), child_s, partial
+        )
+
+    if result is None:
+        result = {
+            "metric": "zdt1_nsga2_generations_per_sec",
+            "value": 0.0,
+            "unit": "generations/sec (pop=200, dim=30)",
+            "vs_baseline": 0.0,
+            "configs": {},
+            "error": f"bench child produced no result; {diag}",
+        }
+    if diag:
+        result.setdefault("diagnostic", diag)
+    result["device_mode"] = device_mode
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_FLAG):
+        child_main()
+    else:
+        orchestrate()

@@ -173,25 +173,28 @@ def _optimize_on_device(
             optimizer, eval_fn, num_generations, termination, logger
         )
 
-    if mesh is not None:
+    def _shard_if_divisible(state):
+        if mesh is None:
+            return state
         from dmosopt_tpu.parallel.mesh import shard_state
 
-        pop = optimizer.popsize
+        pop = getattr(optimizer, "capacity", optimizer.popsize)
         pop_axis = mesh.axis_names[0]
         n_shards = mesh.shape[pop_axis]  # sharding is over the first axis only
         if pop % n_shards == 0:
-            state = shard_state(state, pop, mesh, axis=pop_axis)
-            optimizer.state = state
-        else:
-            import warnings
+            return shard_state(state, pop, mesh, axis=pop_axis)
+        import warnings
 
-            msg = (
-                f"popsize {pop} not divisible by mesh axis "
-                f"{pop_axis!r} size {n_shards}; running replicated"
-            )
-            warnings.warn(msg)
-            if logger is not None:
-                logger.warning(msg)
+        msg = (
+            f"popsize {pop} not divisible by mesh axis "
+            f"{pop_axis!r} size {n_shards}; running replicated"
+        )
+        warnings.warn(msg)
+        if logger is not None:
+            logger.warning(msg)
+        return state
+
+    optimizer.state = state = _shard_if_divisible(state)
 
     def step(state, k):
         x_gen, state = optimizer.generate_strategy(k, state)
@@ -204,28 +207,38 @@ def _optimize_on_device(
     def run_chunk(state, keys):
         return jax.lax.scan(step, state, keys)
 
-    if termination is None:
+    adaptive = getattr(optimizer, "adaptive_population_size", False)
+
+    if termination is None and not adaptive:
         keys = jax.random.split(key, num_generations)
         state, (x_traj, y_traj) = run_chunk(state, keys)
         optimizer.state = state
         return _as_np(x_traj), _as_np(y_traj), num_generations
 
     # With a termination criterion, the criterion is the sole stopping rule
-    # (the reference switches to itertools.count, MOASMO.py:91-93);
-    # num_generations is ignored.
+    # (the reference switches to itertools.count, MOASMO.py:91-93) and
+    # num_generations is ignored. Adaptive population sizing also forces
+    # chunking: capacity growth (a shape change) can only happen at these
+    # host boundaries.
     x_chunks, y_chunks = [], []
     gen = 0
     n_eval = 0
     noff = offspring_per_generation(optimizer)
-    eval_budget = getattr(termination, "eval_budget", lambda: None)()
+    eval_budget = None
+    if termination is not None:
+        eval_budget = getattr(termination, "eval_budget", lambda: None)()
 
     def terminated():
+        if termination is None:
+            return gen >= num_generations
         pop_x, pop_y = optimizer.get_population_strategy(optimizer.state)
         opt = OptHistory(gen, n_eval, _as_np(pop_x), _as_np(pop_y), None)
         return termination.has_terminated(opt)
 
     while not terminated():
         n = termination_check_interval
+        if termination is None:
+            n = min(n, num_generations - gen)
         if eval_budget is not None:
             # the budget is a hard cap: run only whole generations that
             # fit under it; when none fits, stop short rather than over
@@ -251,6 +264,17 @@ def _optimize_on_device(
         gen += n
         n_eval += n * x_traj.shape[1]
         optimizer.state = state
+        if adaptive and optimizer.maybe_grow_capacity():
+            # shapes changed: re-shard for the new capacity (next
+            # run_chunk call re-traces) and track the new offspring width
+            optimizer.state = _shard_if_divisible(optimizer.state)
+            noff = offspring_per_generation(optimizer)
+            if logger is not None:
+                logger.info(
+                    f"{optimizer.name}: population capacity grown to "
+                    f"{optimizer.capacity} "
+                    f"(live size {int(optimizer.state.n_active)})"
+                )
     if logger is not None:
         reasons = getattr(termination, "stop_reasons", lambda: [])()
         logger.info(
@@ -271,7 +295,25 @@ def _optimize_on_device(
             np.zeros((0, noff, n_obj_cols), np.float32),
             0,
         )
-    return np.concatenate(x_chunks), np.concatenate(y_chunks), gen
+    return _concat_offspring_chunks(x_chunks), _concat_offspring_chunks(y_chunks), gen
+
+
+def _concat_offspring_chunks(chunks):
+    """Concatenate per-chunk (gens, noff, cols) trajectories whose
+    offspring width can differ after an adaptive capacity growth. Narrow
+    chunks are padded by repeating their last offspring column — real,
+    already-evaluated points, so downstream consumers (archives, dedupe,
+    surrogate training) see only valid rows."""
+    noff = max(c.shape[1] for c in chunks)
+    padded = [
+        c
+        if c.shape[1] == noff
+        else np.concatenate(
+            [c, np.repeat(c[:, -1:], noff - c.shape[1], axis=1)], axis=1
+        )
+        for c in chunks
+    ]
+    return np.concatenate(padded)
 
 
 def _optimize_host_loop(optimizer, eval_fn, num_generations, termination, logger):

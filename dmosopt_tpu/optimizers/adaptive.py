@@ -1,0 +1,74 @@
+"""Adaptive population sizing — the alive-mask, fixed-capacity pattern.
+
+Reference semantics (dmosopt/NSGA2.py:223-265, dmosopt/AGEMOEA.py:217-260,
+dmosopt/SMPSO.py:234-270): after each survival step the optimizer measures
+population diversity (the fraction of the population on front 0) and the
+coefficient of variation of the front's crowding distances, then grows the
+population 1.2x when diversity is low or shrinks it 0.9x when high, within
+``[min_population_size, max_population_size]``.
+
+TPU redesign: XLA programs have static shapes, so the population lives in
+a fixed-capacity array and the live size is a traced ``n_active`` scalar
+carried in the optimizer state, updated in-graph by the reference formula
+— generation steps stay scannable with zero recompiles while the size
+moves inside the capacity. When ``n_active`` pins at the capacity ceiling,
+the host grows the capacity at the next scan-chunk boundary (doubling,
+clamped to ``max_population_size``); each new capacity re-traces once, so
+a full 100 -> 2000 ramp costs ~5 compiles instead of one per size change.
+Offspring batches always fill the capacity (every slot breeds from live
+parents), which keeps shapes static at the price of extra — but valid —
+candidate evaluations while ``n_active < capacity``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dmosopt_tpu.ops.distances import crowding_distance
+
+
+def population_diversity(y, rank, active_mask, n_active):
+    """In-graph PopulationDiversity (reference indicators.py:316-335):
+    fraction of live points on front 0 and std/mean of their crowding
+    distances (0 when fewer than 2 finite values or zero mean)."""
+    front0 = active_mask & (rank == 0)
+    diversity = front0.sum() / jnp.maximum(n_active, 1)
+    cd = crowding_distance(y, active_mask)
+    finite = front0 & jnp.isfinite(cd)
+    cnt = finite.sum()
+    mean = jnp.sum(jnp.where(finite, cd, 0.0)) / jnp.maximum(cnt, 1)
+    var = jnp.sum(jnp.where(finite, (cd - mean) ** 2, 0.0)) / jnp.maximum(
+        cnt, 1
+    )
+    spread = jnp.where(
+        (cnt > 1) & (mean != 0.0), jnp.sqrt(var) / mean, 0.0
+    )
+    return diversity, spread
+
+
+def adapt_population_size(
+    y_sorted, rank_sorted, n_active, *, min_size: int, max_size: int,
+    capacity: int
+):
+    """New live size per the reference update rule (NSGA2.py:245-266):
+    low diversity + tight spread -> grow 1.2x (toward ``max_size``),
+    high diversity or wide spread -> shrink 0.9x (toward ``min_size``).
+    The result is additionally clamped to the static ``capacity``; the
+    host grows the capacity when the size pins at that ceiling."""
+    active = jnp.arange(rank_sorted.shape[0]) < n_active
+    diversity, spread = population_diversity(
+        y_sorted, rank_sorted, active, n_active
+    )
+    cur = n_active.astype(jnp.float32)
+    grow = (diversity < 0.5) & (spread < 2.0)
+    shrink = (diversity > 0.9) | (spread > 1.0)
+    new = jnp.where(
+        grow,
+        jnp.minimum(max_size, (cur * 1.2).astype(jnp.int32)),
+        jnp.where(
+            shrink,
+            jnp.maximum(min_size, (cur * 0.9).astype(jnp.int32)),
+            n_active,
+        ),
+    )
+    return jnp.clip(new, 1, capacity).astype(jnp.int32)

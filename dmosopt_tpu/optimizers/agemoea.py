@@ -173,14 +173,15 @@ def _survival_score(y, front_mask, ideal):
     return normalization, p, crowd
 
 
-def environmental_selection(x, y, pop: int, x_keys=None):
+def environmental_selection(x, y, pop: int, x_keys=None, mask=None):
     """Jitted AGE-MOEA environmental selection over fixed-capacity arrays
     (reference AGEMOEA.py:433-501). Duplicate rows are masked out instead
-    of removed (static shapes). Returns (perm, rank, crowd) where
+    of removed (static shapes); `mask` marks additional dead rows (the
+    adaptive-population alive mask). Returns (perm, rank, crowd) where
     perm[:pop] are the survivors best-first."""
     N, d = y.shape
-    dup = duplicate_mask(x)
-    valid = ~dup
+    dup = duplicate_mask(x, mask=mask)
+    valid = ~dup if mask is None else (~dup & mask)
     rank = non_dominated_rank(y, mask=valid, stop_count=pop)
 
     front1 = (rank == 0) & valid
@@ -210,6 +211,7 @@ class AGEMOEAState(NamedTuple):
     rank: jax.Array  # (P,)
     crowd_dist: jax.Array  # (P,)
     bounds: jax.Array  # (n, 2)
+    n_active: jax.Array  # () int32 — live size (== P unless adaptive)
 
 
 class AGEMOEA(MOEA):
@@ -258,7 +260,7 @@ class AGEMOEA(MOEA):
     # ------------------------------------------------------------ pure fns
 
     def initialize_state(self, key, x, y, bounds) -> AGEMOEAState:
-        P = self.popsize
+        P = self.capacity
         perm, rank, crowd = environmental_selection(
             x, y, P, x_keys=self._x_keys(x)
         )
@@ -269,10 +271,11 @@ class AGEMOEA(MOEA):
             rank=rank[keep],
             crowd_dist=crowd[keep],
             bounds=bounds,
+            n_active=jnp.asarray(min(self.popsize, P), jnp.int32),
         )
 
     def generate_strategy(self, key, state: AGEMOEAState):
-        pop = self.popsize
+        pop = self.capacity
         poolsize = self.opt_params.poolsize
         npairs = pop // 2
         xlb, xub = state.bounds[:, 0], state.bounds[:, 1]
@@ -286,16 +289,25 @@ class AGEMOEA(MOEA):
         )
 
         k_pool, k_pick, k_op, k_sbx, k_mut = jax.random.split(key, 5)
-        pool_idx = tournament_selection(
-            k_pool, poolsize, state.rank, -state.crowd_dist
-        )
+        if self.adaptive_population_size:
+            active = jnp.arange(pop) < state.n_active
+            pool_idx = tournament_selection(
+                k_pool, poolsize, state.rank, -state.crowd_dist, mask=active
+            )
+            pool_n = jnp.clip(state.n_active // 2, 2, poolsize)
+        else:
+            pool_idx = tournament_selection(
+                k_pool, poolsize, state.rank, -state.crowd_dist
+            )
+            pool_n = poolsize
         pool = state.population_parm[pool_idx]
 
-        i1 = jax.random.randint(k_pick, (npairs,), 0, poolsize)
+        i1 = jax.random.randint(k_pick, (npairs,), 0, pool_n)
         shift = jax.random.randint(
-            jax.random.fold_in(k_pick, 1), (npairs,), 1, poolsize
+            jax.random.fold_in(k_pick, 1), (npairs,), 1,
+            jnp.maximum(pool_n, 2) if self.adaptive_population_size else pool_n,
         )
-        i2 = (i1 + shift) % poolsize
+        i2 = (i1 + shift) % pool_n
         p1, p2 = pool[i1], pool[i2]
 
         pc = jnp.asarray(self.opt_params.crossover_prob, f32)
@@ -321,20 +333,67 @@ class AGEMOEA(MOEA):
         return x_gen, state
 
     def update_strategy(self, state: AGEMOEAState, x_gen, y_gen) -> AGEMOEAState:
-        P = self.popsize
+        P = self.capacity
         x = jnp.concatenate([state.population_parm, x_gen], axis=0)
         y = jnp.concatenate([state.population_obj, y_gen], axis=0)
+        mask = None
+        if self.adaptive_population_size:
+            mask = jnp.concatenate(
+                [
+                    jnp.arange(P) < state.n_active,
+                    jnp.ones((x_gen.shape[0],), bool),
+                ]
+            )
         perm, rank, crowd = environmental_selection(
-            x, y, P, x_keys=self._x_keys(x)
+            x, y, P, x_keys=self._x_keys(x), mask=mask
         )
         keep = perm[:P]
-        return state._replace(
+        state = state._replace(
             population_parm=x[keep],
             population_obj=y[keep],
             rank=rank[keep],
             crowd_dist=crowd[keep],
         )
+        if self.adaptive_population_size:
+            from dmosopt_tpu.optimizers.adaptive import adapt_population_size
+
+            new_n = adapt_population_size(
+                state.population_obj, state.rank, state.n_active,
+                min_size=int(self.opt_params.min_population_size),
+                max_size=int(self.opt_params.max_population_size),
+                capacity=P,
+            )
+            state = state._replace(n_active=new_n)
+        return state
 
     def get_population_strategy(self, state=None):
         state = state if state is not None else self.state
+        if self.adaptive_population_size:
+            n = int(state.n_active)  # host-side API: live rows only
+            return state.population_parm[:n], state.population_obj[:n]
         return state.population_parm, state.population_obj
+
+    def expand_capacity(self, state: AGEMOEAState, new_capacity: int) -> AGEMOEAState:
+        """Pad the sorted population arrays to a larger static capacity
+        (rows beyond ``n_active`` are masked everywhere; padding repeats
+        the worst sorted row so every slot holds a real point)."""
+        extra = new_capacity - state.population_parm.shape[0]
+
+        def pad(a):
+            return jnp.concatenate(
+                [a, jnp.repeat(a[-1:], extra, axis=0)], axis=0
+            )
+
+        return state._replace(
+            population_parm=pad(state.population_parm),
+            population_obj=pad(state.population_obj),
+            rank=jnp.concatenate(
+                [
+                    state.rank,
+                    jnp.full((extra,), new_capacity, state.rank.dtype),
+                ]
+            ),
+            crowd_dist=jnp.concatenate(
+                [state.crowd_dist, jnp.zeros((extra,), state.crowd_dist.dtype)]
+            ),
+        )

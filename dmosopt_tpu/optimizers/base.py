@@ -87,6 +87,9 @@ class MOEA:
         for k, v in kwargs.items():
             if k not in self.opt_params or v is not None:
                 self.opt_params[k] = v
+        # static array capacity; equals popsize unless adaptive population
+        # sizing grows it (the live size is then the state's `n_active`)
+        self.capacity = self.popsize
         self.state = None
         self._jit_generate = None
         self._jit_update = None
@@ -163,6 +166,42 @@ class MOEA:
         else:
             raise RuntimeError(f"unknown sampling method {method}")
         return x
+
+    # ------------------------------------------- adaptive population size
+
+    @property
+    def adaptive_population_size(self) -> bool:
+        return bool(getattr(self.opt_params, "adaptive_population_size", False))
+
+    def maybe_grow_capacity(self) -> bool:
+        """Host-side growth hook, called between scan chunks: when the
+        live size has pinned at the static capacity ceiling, double the
+        capacity (clamped to ``max_population_size``) and pad the state.
+        The next jitted call re-traces once for the new shapes. Returns
+        True when the capacity changed."""
+        if not self.adaptive_population_size or self.state is None:
+            return False
+        n_active = getattr(self.state, "n_active", None)
+        if n_active is None:
+            return False
+        max_pop = int(
+            getattr(self.opt_params, "max_population_size", self.capacity)
+        )
+        if int(n_active) >= self.capacity and self.capacity < max_pop:
+            new_cap = min(max_pop, self.capacity * 2)
+            self.state = self.expand_capacity(self.state, new_cap)
+            self.capacity = new_cap
+            if "poolsize" in self.opt_params:
+                self.opt_params.poolsize = int(round(new_cap / 2.0))
+            return True
+        return False
+
+    def expand_capacity(self, state, new_capacity: int):
+        """Pad population-leading state arrays to ``new_capacity`` rows.
+        Optimizers supporting adaptive population size override this."""
+        raise NotImplementedError(
+            f"{self.name} does not support adaptive population size"
+        )
 
     # ----------------------------------------------------- pure functions
 

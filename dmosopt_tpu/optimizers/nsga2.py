@@ -22,6 +22,7 @@ from typing import Any, Dict, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from dmosopt_tpu.optimizers.adaptive import adapt_population_size
 from dmosopt_tpu.optimizers.base import MOEA
 from dmosopt_tpu.ops import (
     crowding_distance,
@@ -34,10 +35,11 @@ from dmosopt_tpu.ops import (
 
 
 class NSGA2State(NamedTuple):
-    population_parm: jax.Array  # (pop, n)
-    population_obj: jax.Array  # (pop, d)
-    rank: jax.Array  # (pop,)
+    population_parm: jax.Array  # (cap, n)
+    population_obj: jax.Array  # (cap, d)
+    rank: jax.Array  # (cap,)
     bounds: jax.Array  # (n, 2)
+    n_active: jax.Array  # () int32 — live size (== cap unless adaptive)
     # adaptive hyperparameters (in-graph; reference keeps them in opt_params)
     di_crossover: jax.Array  # (n,)
     di_mutation: jax.Array  # (n,)
@@ -90,13 +92,16 @@ class NSGA2(MOEA):
             "min_success_rate": 0.2,
             "max_success_rate": 0.75,
             "adaptive_operator_rates": False,
+            "max_population_size": 2000,
+            "min_population_size": 100,
+            "adaptive_population_size": False,
         }
 
     # ------------------------------------------------------------ pure fns
 
     def initialize_state(self, key, x, y, bounds) -> NSGA2State:
         n = self.nInput
-        pop = self.popsize
+        pop = self.capacity
         xs, ys, rank, _, _ = sort_mo(
             x,
             y,
@@ -124,23 +129,38 @@ class NSGA2(MOEA):
             successful_mutations=jnp.zeros((), f32),
             total_mutations=jnp.zeros((), f32),
             last_is_crossover=jnp.zeros((2 * (pop // 2),), bool),
+            n_active=jnp.asarray(min(self.popsize, pop), jnp.int32),
         )
 
     def generate_strategy(self, key, state: NSGA2State):
-        pop = self.popsize
+        pop = self.capacity
         poolsize = self.opt_params.poolsize
         npairs = pop // 2
         xlb, xub = state.bounds[:, 0], state.bounds[:, 1]
 
         k_pool, k_pick, k_op, k_sbx, k_mut = jax.random.split(key, 5)
 
-        pool_idx = tournament_selection(k_pool, poolsize, state.rank)
+        if self.adaptive_population_size:
+            # only live rows enter the mating pool, and pair sampling is
+            # bounded by the live pool size (a traced scalar) — every
+            # offspring slot still breeds, so shapes stay static
+            active = jnp.arange(pop) < state.n_active
+            pool_idx = tournament_selection(
+                k_pool, poolsize, state.rank, mask=active
+            )
+            pool_n = jnp.clip(state.n_active // 2, 2, poolsize)
+        else:
+            pool_idx = tournament_selection(k_pool, poolsize, state.rank)
+            pool_n = poolsize
         pool = state.population_parm[pool_idx]
 
         # Two distinct parents per pair slot.
-        i1 = jax.random.randint(k_pick, (npairs,), 0, poolsize)
-        shift = jax.random.randint(jax.random.fold_in(k_pick, 1), (npairs,), 1, poolsize)
-        i2 = (i1 + shift) % poolsize
+        i1 = jax.random.randint(k_pick, (npairs,), 0, pool_n)
+        shift = jax.random.randint(
+            jax.random.fold_in(k_pick, 1), (npairs,), 1,
+            jnp.maximum(pool_n, 2) if self.adaptive_population_size else pool_n,
+        )
+        i2 = (i1 + shift) % pool_n
         p1, p2 = pool[i1], pool[i2]
 
         # Choose operator per slot with the reference's relative frequencies:
@@ -177,17 +197,28 @@ class NSGA2(MOEA):
         return x_gen, state
 
     def update_strategy(self, state: NSGA2State, x_gen, y_gen) -> NSGA2State:
-        pop = self.popsize
+        pop = self.capacity
         noff = x_gen.shape[0]
 
         parm = jnp.concatenate([x_gen, state.population_parm], axis=0)
         obj = jnp.concatenate([y_gen, state.population_obj], axis=0)
 
+        mask = None
+        if self.adaptive_population_size:
+            # offspring are all live; parent rows beyond the live size
+            # are masked out of survival
+            mask = jnp.concatenate(
+                [
+                    jnp.ones((noff,), bool),
+                    jnp.arange(pop) < state.n_active,
+                ]
+            )
         xs, ys, rank, _, perm = sort_mo(
             parm,
             obj,
             x_distance_metrics=self.x_distance_metrics,
             y_distance_metrics=self.y_distance_metrics,
+            mask=mask,
             need=pop,
         )
         keep = perm[:pop]
@@ -198,6 +229,22 @@ class NSGA2(MOEA):
             population_obj=ys[:pop],
             rank=rank[:pop],
         )
+
+        if self.adaptive_population_size:
+            # measure diversity over the surviving live set, then move
+            # the live size (reference NSGA2.py:232-266); positions
+            # [n_active, new_size) of the sorted pool are the next-best
+            # real candidates, so growth re-admits them
+            survived_off = survived_off & (
+                jnp.arange(pop) < state.n_active
+            )
+            new_n = adapt_population_size(
+                ys[:pop], rank[:pop], state.n_active,
+                min_size=int(self.opt_params.min_population_size),
+                max_size=int(self.opt_params.max_population_size),
+                capacity=pop,
+            )
+            state = state._replace(n_active=new_n)
 
         if self.opt_params.adaptive_operator_rates:
             is_x = state.last_is_crossover
@@ -269,4 +316,30 @@ class NSGA2(MOEA):
 
     def get_population_strategy(self, state=None):
         state = state if state is not None else self.state
+        if self.adaptive_population_size:
+            n = int(state.n_active)  # host-side API: live rows only
+            return state.population_parm[:n], state.population_obj[:n]
         return state.population_parm, state.population_obj
+
+    def expand_capacity(self, state: NSGA2State, new_capacity: int) -> NSGA2State:
+        """Pad the sorted population arrays to a larger static capacity
+        (rows beyond ``n_active`` are masked everywhere; padding repeats
+        the worst sorted row so every slot holds a real point)."""
+        extra = new_capacity - state.population_parm.shape[0]
+
+        def pad(a):
+            return jnp.concatenate(
+                [a, jnp.repeat(a[-1:], extra, axis=0)], axis=0
+            )
+
+        return state._replace(
+            population_parm=pad(state.population_parm),
+            population_obj=pad(state.population_obj),
+            rank=jnp.concatenate(
+                [
+                    state.rank,
+                    jnp.full((extra,), new_capacity, state.rank.dtype),
+                ]
+            ),
+            last_is_crossover=jnp.zeros((2 * (new_capacity // 2),), bool),
+        )

@@ -99,6 +99,16 @@ class JaxBatchEvaluator:
             self._fn = jax.jit(batch_fun)
             self._n_shards = 1
 
+    @staticmethod
+    def _to_host(o):
+        # a DCN-spanning mesh shards outputs across processes; fetching
+        # them needs an explicit cross-process all-gather first
+        if isinstance(o, jax.Array) and not o.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            o = multihost_utils.process_allgather(o, tiled=True)
+        return np.asarray(o)
+
     def _call(self, X: np.ndarray):
         B = X.shape[0]
         pad = (-B) % self._n_shards
@@ -107,7 +117,7 @@ class JaxBatchEvaluator:
         out = self._fn(jnp.asarray(X, jnp.float32))
         if not isinstance(out, tuple):
             out = (out,)
-        return tuple(np.asarray(o)[:B] for o in out)
+        return tuple(self._to_host(o)[:B] for o in out)
 
     def evaluate_batch(
         self, space_vals_list: Sequence[Dict[Any, np.ndarray]]

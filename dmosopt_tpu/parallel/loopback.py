@@ -50,7 +50,14 @@ def launch_loopback_cluster(
     env["XLA_FLAGS"] = (
         flags + f" --xla_force_host_platform_device_count={devices_per_process}"
     ).strip()
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # the accelerator plugin's sitecustomize stalls even CPU-platform
+    # processes when the tunnel is wedged — keep it off the ranks' path
+    keep = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in os.path.basename(p)
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([repo] + keep)
 
     procs = [
         subprocess.Popen(

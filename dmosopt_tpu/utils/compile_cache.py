@@ -1,0 +1,39 @@
+"""Persistent XLA compilation cache, keyed by host machine.
+
+XLA's persistent cache entries are AOT-compiled for the machine that
+built them; loading them on a host with different CPU features spews
+`cpu_aot_loader` warnings and risks SIGILL. Cache dirs therefore get a
+per-machine fingerprint subdirectory so a container migrating between
+hosts starts a fresh cache instead of loading a mismatched one.
+"""
+
+import hashlib
+import os
+
+
+def _machine_fingerprint() -> str:
+    """Stable id for the execution host's ISA surface."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(flags.encode()).hexdigest()[:12]
+
+
+def enable_persistent_cache(base_dir: str) -> str:
+    """Point jax's compilation cache at `base_dir/<machine-id>/` and
+    return that path. Must be called after `import jax` but has no
+    backend side effects."""
+    import jax
+
+    path = os.path.join(base_dir, _machine_fingerprint())
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return path

@@ -24,13 +24,15 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.devices()
 
 # The suite is compile-dominated on a 1-core box: persist XLA compilations
-# across runs so only the first run pays (cache dir is gitignored).
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(__file__), ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# across runs so only the first run pays (cache dir is gitignored,
+# machine-keyed so a container migrating hosts doesn't load mismatched
+# AOT entries — those spew cpu_aot_loader warnings and risk SIGILL).
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+from dmosopt_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache(os.path.join(os.path.dirname(__file__), ".jax_cache"))
 
 import numpy as np
 import pytest

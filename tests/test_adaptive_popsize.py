@@ -1,0 +1,127 @@
+"""Adaptive population sizing (reference dmosopt/NSGA2.py:223-265,
+dmosopt/AGEMOEA.py:217-260): the live size follows the diversity-driven
+grow/shrink rule in-graph, and the static capacity grows at host chunk
+boundaries when the live size pins at its ceiling."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu import moasmo, sampling
+from dmosopt_tpu.models import Model
+from dmosopt_tpu.optimizers.adaptive import adapt_population_size
+from dmosopt_tpu.optimizers.agemoea import AGEMOEA
+from dmosopt_tpu.optimizers.nsga2 import NSGA2
+from dmosopt_tpu.benchmarks.zdt import zdt1
+
+DIM = 6
+
+
+class _Obj:
+    def __init__(self, fn):
+        self.evaluate = fn
+
+
+def _drive(opt, fn, ngen):
+    bounds = np.stack([np.zeros(DIM), np.ones(DIM)], 1)
+    x0 = sampling.lh(opt.popsize, DIM, 1)
+    y0 = np.asarray(fn(jnp.asarray(x0)))
+    opt.initialize_strategy(x0, y0, bounds, random=1)
+    gen = moasmo.optimize(
+        ngen, opt, Model(objective=_Obj(fn)), DIM, 2,
+        np.zeros(DIM), np.ones(DIM), popsize=opt.popsize, local_random=3,
+    )
+    try:
+        next(gen)
+        raise AssertionError("surrogate-mode optimize must not yield")
+    except StopIteration as ex:
+        return ex.value
+
+
+def test_formula_grow_shrink_hold():
+    """Pin the reference update rule branch by branch
+    (dmosopt/NSGA2.py:245-266), including the int() truncation."""
+    cap = 64
+    y = jnp.linspace(0.0, 1.0, cap)[:, None] * jnp.ones((1, 2))
+    n = jnp.asarray(20, jnp.int32)
+
+    # thin front: 1 of 20 on front 0 -> diversity 0.05, spread 0 -> grow
+    rank = jnp.arange(cap, dtype=jnp.int32)
+    assert int(
+        adapt_population_size(y, rank, n, min_size=8, max_size=2000,
+                              capacity=cap)
+    ) == int(20 * 1.2)
+
+    # everything on front 0 -> diversity 1.0 -> shrink (18 = int(20*0.9))
+    rank0 = jnp.zeros((cap,), jnp.int32)
+    assert int(
+        adapt_population_size(y, rank0, n, min_size=8, max_size=2000,
+                              capacity=cap)
+    ) == 18
+
+    # shrink respects min_size
+    assert int(
+        adapt_population_size(y, rank0, n, min_size=20, max_size=2000,
+                              capacity=cap)
+    ) == 20
+
+    # growth clamps to the static capacity
+    assert int(
+        adapt_population_size(y, rank, jnp.asarray(60, jnp.int32),
+                              min_size=8, max_size=2000, capacity=cap)
+    ) == cap
+
+
+@pytest.mark.parametrize("cls", [NSGA2, AGEMOEA])
+def test_shrinks_on_converged_front(cls):
+    """ZDT1 converges onto front 0 quickly -> diversity > 0.9 -> the live
+    size shrinks toward min_population_size; host API returns only live
+    rows."""
+    opt = cls(
+        popsize=16, nInput=DIM, nOutput=2, model=None,
+        adaptive_population_size=True, min_population_size=8,
+        max_population_size=64,
+    )
+    res = _drive(opt, zdt1, 40)
+    na = int(opt.state.n_active)
+    assert na == 8
+    assert res.best_x.shape[0] == na
+    assert np.all(np.isfinite(res.best_y))
+
+
+@pytest.mark.parametrize("cls", [NSGA2, AGEMOEA])
+def test_grows_and_expands_capacity(cls):
+    """A near-single-objective landscape keeps front 0 thin (low
+    diversity) -> the live size grows past the initial capacity, forcing
+    a host-side capacity expansion and a re-trace."""
+
+    def thin_front(X):  # strongly correlated objectives -> thin front
+        s = jnp.sum(X, axis=1)
+        q = jnp.sum((X - 0.05) ** 2, axis=1)
+        return jnp.stack([s, q], axis=1)
+
+    opt = cls(
+        popsize=16, nInput=DIM, nOutput=2, model=None,
+        adaptive_population_size=True, min_population_size=8,
+        max_population_size=48,
+    )
+    res = _drive(opt, thin_front, 30)
+    na = int(opt.state.n_active)
+    assert opt.capacity > 16, "capacity never grew"
+    assert opt.capacity <= 48
+    assert na > 16
+    assert res.best_x.shape[0] == na
+    assert np.all(np.isfinite(res.best_y))
+    # the expanded state stays internally consistent
+    assert opt.state.population_parm.shape[0] == opt.capacity
+    assert opt.state.rank.shape[0] == opt.capacity
+
+
+def test_default_off_is_unchanged():
+    """With the default (off), state carries n_active == popsize and the
+    whole population is returned — bitwise-identical behavior."""
+    opt = NSGA2(popsize=16, nInput=DIM, nOutput=2, model=None)
+    res = _drive(opt, zdt1, 10)
+    assert int(opt.state.n_active) == 16
+    assert res.best_x.shape[0] == 16

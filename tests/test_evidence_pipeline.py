@@ -1,0 +1,98 @@
+"""The round-end evidence artifacts must be unkillable: `python bench.py`
+and `dryrun_multichip(n)` have to produce green output on a host whose
+accelerator tunnel is wedged (backend init hangs) or whose backend is
+simply absent. These tests drive both entry points as real subprocesses
+the way the driver does.
+
+Reference for what the artifacts cover:
+/root/reference/tests/test_moo_benchmarks.py:25-48 (bench configs) and
+/root/reference/dmosopt/dmosopt.py:2518-2536 (distributed launch).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env(**over):
+    """Env for a child that must NOT inherit the test process's forced
+    CPU platform/device-count settings."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO
+    env.update(over)
+    return env
+
+
+def test_env_forced_cpu_devices_parsing():
+    import __graft_entry__ as g
+
+    saved = {
+        k: os.environ.get(k) for k in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (
+            "--foo --xla_force_host_platform_device_count=8"
+        )
+        assert g._env_forced_cpu_devices() == 8
+        os.environ["XLA_FLAGS"] = ""
+        assert g._env_forced_cpu_devices() == 1
+        os.environ["JAX_PLATFORMS"] = "axon"
+        assert g._env_forced_cpu_devices() is None
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.slow
+def test_bench_emits_json_even_with_broken_backend():
+    """bench.py orchestration: a default env whose backend init FAILS
+    must still yield rc=0 and one parseable JSON line, flagged as the
+    CPU fallback."""
+    env = _clean_env(
+        JAX_PLATFORMS="definitely-not-a-backend",
+        DMOSOPT_BENCH_SMOKE="1",
+        DMOSOPT_BENCH_PROBE_TIMEOUT="60",
+        DMOSOPT_BENCH_TIMEOUT="600",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, proc.stdout
+    result = json.loads(lines[0])
+    assert result["device_mode"] == "cpu-fallback"
+    assert result["metric"] == "zdt1_nsga2_generations_per_sec"
+    assert result["value"] > 0  # the smoke loop actually ran on CPU
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_wall_clock_budget():
+    """dryrun_multichip(8) from a single-device parent must respawn onto
+    the virtual CPU mesh and finish well inside the driver's budget
+    (round 3 regressed to >20 min and timed out; the bar here is 420 s
+    on this 1-core box, cold-cache worst case ~2 min)."""
+    env = _clean_env(JAX_PLATFORMS="cpu")  # 1 CPU device -> respawn path
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420,
+    )
+    wall = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "VIRTUAL CPU mesh" in proc.stdout
+    assert wall < 420, f"dryrun took {wall:.0f}s"
