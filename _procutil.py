@@ -1,0 +1,84 @@
+"""Process-group-safe subprocess helpers, shared by the repo-root
+orchestrators (`bench.py`, `__graft_entry__.py`).
+
+Deliberately jax-free: both callers must be importable/runnable while
+the accelerator backend is wedged (backend init hangs), so nothing here
+may touch jax. Children are spawned with ``start_new_session=True`` and
+killed by process group: plain ``subprocess.run(capture_output=True,
+timeout=...)`` only kills the direct child, and a pipe-holding
+grandchild (which a wedged accelerator plugin can fork) then blocks the
+implicit ``communicate()`` unboundedly — the failure mode that cost the
+round-4 MULTICHIP artifact.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+
+def axon_free_pythonpath(repo_dir: str, pythonpath=None) -> str:
+    """PYTHONPATH for a CPU-fallback child: the accelerator plugin's
+    sitecustomize ('axon'-named entries) comes OFF the path — it stalls
+    even CPU-platform processes when the tunnel is wedged — and
+    `repo_dir` is prepended so the package resolves without a wheel."""
+    src = os.environ.get("PYTHONPATH", "") if pythonpath is None else pythonpath
+    keep = [
+        p
+        for p in src.split(os.pathsep)
+        if p and "axon" not in os.path.basename(p)
+    ]
+    return os.pathsep.join([repo_dir] + keep)
+
+
+def run_probe(code: str, timeout_s: float):
+    """Spawn ``python -c code`` as a backend probe: tagged with
+    ``_DMOSOPT_TPU_PROBE=1`` (so test shims can target it), own session,
+    stderr silenced (backend-init spew — callers parse stdout only),
+    process group killed at the deadline. Returns ``(stdout, rc)`` with
+    rc == "timeout" on a hang."""
+    env = dict(os.environ)
+    env["_DMOSOPT_TPU_PROBE"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True, env=env,
+    )
+    out, _, rc = communicate_bounded(proc, timeout_s)
+    return out, rc
+
+
+def kill_process_group(proc: "subprocess.Popen") -> None:
+    """SIGKILL the child's whole process group (requires the child to
+    have been spawned with ``start_new_session=True``), falling back to
+    killing the direct child alone."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def communicate_bounded(proc: "subprocess.Popen", timeout_s: float):
+    """``communicate()`` with a process-group kill on timeout. Returns
+    ``(stdout, stderr, rc)`` where rc is the string ``"timeout"`` when
+    the deadline hit. The child is always reaped (no zombie)."""
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return out or "", err or "", proc.returncode
+    except subprocess.TimeoutExpired:
+        kill_process_group(proc)
+        try:
+            out, err = proc.communicate(timeout=5)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+            for pipe in (proc.stdout, proc.stderr):
+                if pipe is not None:
+                    pipe.close()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        return out or "", err or "", "timeout"

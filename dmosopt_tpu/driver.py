@@ -49,6 +49,18 @@ logger = logging.getLogger(__name__)
 dopt_dict: Dict[str, "DistOptimizer"] = {}
 
 
+def _is_primary_process() -> bool:
+    """True on the process that owns checkpoint writes. Single-process
+    runs are always primary; in a `jax.distributed` cluster only process
+    0 is (the reference's rank-0 distwq controller, dmosopt.py:2518)."""
+    import jax
+
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
 # ------------------------------------------------------ objective wrappers
 
 
@@ -222,7 +234,16 @@ class DistOptimizer:
             problem_parameters = ParameterSpace.from_dict(
                 problem_parameters, is_value_only=True
             )
-        restored = self._restore_from_file(file_path, param_space)
+        # multi-process: the resume-vs-fresh decision must be identical on
+        # every rank, and made before rank 0 can create the file — a
+        # non-primary rank must never probe isfile() itself (it could see
+        # rank 0's init_h5 mid-write and diverge into the restore path)
+        self._resuming = self._broadcast_resume_decision(file_path)
+        restored = (
+            self._restore_from_file(file_path, param_space)
+            if self._resuming
+            else None
+        )
         self.old_evals = {}
         self.start_epoch = 0
         if restored is not None:
@@ -340,7 +361,11 @@ class DistOptimizer:
             else HostFunEvaluator(self.eval_fun, n_workers=n_eval_workers)
         )
 
-        if self.save and file_path is not None and not os.path.isfile(file_path):
+        if (
+            self.save and file_path is not None
+            and not self._resuming and not os.path.isfile(file_path)
+            and _is_primary_process()
+        ):
             from dmosopt_tpu.storage import init_h5
 
             init_h5(
@@ -352,6 +377,31 @@ class DistOptimizer:
             )
 
     # --------------------------------------------------------- init helpers
+
+    @staticmethod
+    def _broadcast_resume_decision(file_path) -> bool:
+        """Whether this run restores from `file_path`. Single-process:
+        a plain isfile() check. Multi-process: the primary's answer is
+        broadcast so every rank takes the same branch — and the
+        collective doubles as a barrier that keeps non-primary ranks
+        from racing rank 0's init_h5 write."""
+        exists = file_path is not None and os.path.isfile(file_path)
+        import jax
+
+        try:
+            multi = jax.process_count() > 1
+        except Exception:
+            multi = False
+        if not multi:
+            return exists
+        import numpy as _np
+        from jax.experimental import multihost_utils
+
+        return bool(
+            multihost_utils.broadcast_one_to_all(
+                _np.asarray(exists, dtype=_np.bool_)
+            )
+        )
 
     @staticmethod
     def _check_persistence_config(file_path, save, problem_parameters, space):
@@ -491,6 +541,12 @@ class DistOptimizer:
             self.print_best()
 
     # -------------------------------------------------------- persistence
+    #
+    # Under multi-process SPMD every rank runs the identical driver loop
+    # (self.save stays True everywhere so control flow never diverges),
+    # but only the primary process touches the checkpoint file — the
+    # analogue of the reference's rank-0 distwq controller owning the H5
+    # writes (reference dmosopt.py:2518-2536).
 
     def save_evals(self):
         """Store results of finished evals to file
@@ -520,7 +576,7 @@ class DistOptimizer:
                 )
                 self.storage_dict[problem_id] = []
 
-        if len(finished_evals) > 0:
+        if len(finished_evals) > 0 and _is_primary_process():
             save_to_h5(
                 self.opt_id, self.problem_ids, self.has_problem_ids,
                 self.objective_names, self.feature_dtypes, self.constraint_names,
@@ -531,7 +587,7 @@ class DistOptimizer:
             )
 
     def save_surrogate_evals(self, problem_id, epoch, gen_index, x_sm, y_sm):
-        if x_sm.shape[0] > 0:
+        if x_sm.shape[0] > 0 and _is_primary_process():
             from dmosopt_tpu.storage import save_surrogate_evals_to_h5
 
             save_surrogate_evals_to_h5(
@@ -541,6 +597,8 @@ class DistOptimizer:
             )
 
     def save_optimizer_params(self, problem_id, epoch, optimizer_name, optimizer_params):
+        if not _is_primary_process():
+            return
         from dmosopt_tpu.storage import save_optimizer_params_to_h5
 
         save_optimizer_params_to_h5(
@@ -549,6 +607,8 @@ class DistOptimizer:
         )
 
     def save_stats(self, problem_id, epoch):
+        if not _is_primary_process():
+            return
         from dmosopt_tpu.storage import save_stats_to_h5
 
         save_stats_to_h5(
@@ -887,13 +947,24 @@ def dopt_init(dopt_params, verbose=False, initialize_strategy=False):
 def run(
     dopt_params, time_limit=None, feasible=True,
     return_features=False, return_constraints=False, verbose=True,
+    compile_cache_dir=None,
     **kwargs,
 ):
     """Run a complete MO-ASMO optimization (reference:
     dmosopt/dmosopt.py:2501-2571). Single-process, TPU-backed: no MPI
     roles; the evaluation backend handles batching/sharding. Legacy
     distwq-specific kwargs (spawn_workers, nprocs_per_worker, ...) are
-    accepted and ignored."""
+    accepted and ignored.
+
+    ``compile_cache_dir`` (or the ``DMOSOPT_TPU_CACHE_DIR`` env var)
+    enables a persistent, machine-keyed XLA compilation cache so repeat
+    runs skip the cold-compile tax (tens of seconds per program on CPU;
+    see BASELINE.md cold/warm splits)."""
+    cache_dir = compile_cache_dir or os.environ.get("DMOSOPT_TPU_CACHE_DIR")
+    if cache_dir:
+        from dmosopt_tpu.utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache(cache_dir)
     if time_limit is not None:
         dopt_params = dict(dopt_params)
         dopt_params["time_limit"] = time_limit

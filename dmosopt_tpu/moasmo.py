@@ -161,7 +161,13 @@ def _optimize_on_device(
     sorts) — the production replacement for the reference's MPI farm-out
     of evaluations (reference dmosopt.py:1152-1339).
 
-    Returns (x_traj, y_traj, n_gen_run): stacked offspring per generation.
+    Returns (x_new, y_new, gen_counts): the evaluated offspring flattened
+    to (N, cols) plus the per-generation offspring counts (len == number
+    of generations run, sum == N). Flat-plus-counts instead of a
+    rectangular (gens, noff, cols) stack so an adaptive capacity growth
+    mid-run never needs padding — every returned row is a real, distinct
+    evaluation (no duplicated rows reaching archives or the
+    surrogate-training set).
     """
     bounds = optimizer.bounds
     state = optimizer.state
@@ -213,7 +219,12 @@ def _optimize_on_device(
         keys = jax.random.split(key, num_generations)
         state, (x_traj, y_traj) = run_chunk(state, keys)
         optimizer.state = state
-        return _as_np(x_traj), _as_np(y_traj), num_generations
+        noff = x_traj.shape[1]
+        return (
+            _as_np(x_traj).reshape(-1, x_traj.shape[-1]),
+            _as_np(y_traj).reshape(-1, y_traj.shape[-1]),
+            np.full((num_generations,), noff, dtype=np.int64),
+        )
 
     # With a termination criterion, the criterion is the sole stopping rule
     # (the reference switches to itertools.count, MOASMO.py:91-93) and
@@ -221,6 +232,7 @@ def _optimize_on_device(
     # chunking: capacity growth (a shape change) can only happen at these
     # host boundaries.
     x_chunks, y_chunks = [], []
+    gen_counts = []
     gen = 0
     n_eval = 0
     noff = offspring_per_generation(optimizer)
@@ -261,6 +273,7 @@ def _optimize_on_device(
         state, (x_traj, y_traj) = run_chunk(optimizer.state, keys)
         x_chunks.append(_as_np(x_traj))
         y_chunks.append(_as_np(y_traj))
+        gen_counts.extend([x_traj.shape[1]] * n)
         gen += n
         n_eval += n * x_traj.shape[1]
         optimizer.state = state
@@ -291,36 +304,32 @@ def _optimize_on_device(
             ).shape[1]
         )
         return (
-            np.zeros((0, noff, optimizer.nInput), np.float32),
-            np.zeros((0, noff, n_obj_cols), np.float32),
-            0,
+            np.zeros((0, optimizer.nInput), np.float32),
+            np.zeros((0, n_obj_cols), np.float32),
+            np.zeros((0,), np.int64),
         )
-    return _concat_offspring_chunks(x_chunks), _concat_offspring_chunks(y_chunks), gen
+    return (
+        _flatten_offspring_chunks(x_chunks),
+        _flatten_offspring_chunks(y_chunks),
+        np.asarray(gen_counts, dtype=np.int64),
+    )
 
 
-def _concat_offspring_chunks(chunks):
-    """Concatenate per-chunk (gens, noff, cols) trajectories whose
-    offspring width can differ after an adaptive capacity growth. Narrow
-    chunks are padded by repeating their last offspring column — real,
-    already-evaluated points, so downstream consumers (archives, dedupe,
-    surrogate training) see only valid rows."""
-    noff = max(c.shape[1] for c in chunks)
-    padded = [
-        c
-        if c.shape[1] == noff
-        else np.concatenate(
-            [c, np.repeat(c[:, -1:], noff - c.shape[1], axis=1)], axis=1
-        )
-        for c in chunks
-    ]
-    return np.concatenate(padded)
+def _flatten_offspring_chunks(chunks):
+    """Flatten per-chunk (gens, noff, cols) trajectories — whose offspring
+    width can differ after an adaptive capacity growth — to one (N, cols)
+    array. No padding: every returned row is a distinct evaluation, so
+    archives and the surrogate-training set never see duplicated rows
+    (the per-generation widths travel separately as gen_counts)."""
+    return np.concatenate([c.reshape(-1, c.shape[-1]) for c in chunks])
 
 
 def _optimize_host_loop(optimizer, eval_fn, num_generations, termination, logger):
     """Per-generation host loop for non-scannable optimizers (their
     randomness flows through `optimizer.local_random`, not a jax key).
-    Same return contract as the scan path: (x_traj, y_traj, n_gen_run)."""
+    Same return contract as the scan path: (x_new, y_new, gen_counts)."""
     x_chunks, y_chunks = [], []
+    gen_counts = []
     n_eval = 0
     gen = 0
     it = itertools.count(1) if termination is not None else range(1, num_generations + 1)
@@ -339,8 +348,9 @@ def _optimize_host_loop(optimizer, eval_fn, num_generations, termination, logger
         y_gen = _as_np(eval_fn(jnp.asarray(x_gen))).astype(np.float32)
         optimizer.update(x_gen, y_gen, state_gen)
         n_eval += x_gen.shape[0]
-        x_chunks.append(_as_np(x_gen)[None])
-        y_chunks.append(y_gen[None])
+        x_chunks.append(_as_np(x_gen))
+        y_chunks.append(y_gen)
+        gen_counts.append(x_gen.shape[0])
         gen = i
     if not x_chunks:
         n_obj_cols = int(
@@ -349,11 +359,15 @@ def _optimize_host_loop(optimizer, eval_fn, num_generations, termination, logger
             ).shape[1]
         )
         return (
-            np.zeros((0, 0, optimizer.nInput), np.float32),
-            np.zeros((0, 0, n_obj_cols), np.float32),
-            0,
+            np.zeros((0, optimizer.nInput), np.float32),
+            np.zeros((0, n_obj_cols), np.float32),
+            np.zeros((0,), np.int64),
         )
-    return np.concatenate(x_chunks), np.concatenate(y_chunks), gen
+    return (
+        np.concatenate(x_chunks),
+        np.concatenate(y_chunks),
+        np.asarray(gen_counts, dtype=np.int64),
+    )
 
 
 def optimize(
@@ -413,7 +427,7 @@ def optimize(
 
     if model.objective is not None:
         key, k = jax.random.split(key)
-        x_traj, y_traj, n_gen = _optimize_on_device(
+        x_dev, y_dev, gen_counts = _optimize_on_device(
             optimizer,
             eval_fn,
             num_generations,
@@ -423,11 +437,11 @@ def optimize(
             logger=logger,
             mesh=mesh,
         )
-        noff = x_traj.shape[1]
-        x_new = [x_traj.reshape(-1, x_traj.shape[-1])]
-        y_new = [y_traj.reshape(-1, y_traj.shape[-1])]
+        x_new = [x_dev]
+        y_new = [y_dev]
         gen_indexes.extend(
-            np.full((noff,), i + 1, dtype=np.uint32) for i in range(n_gen)
+            np.full((int(c),), i + 1, dtype=np.uint32)
+            for i, c in enumerate(gen_counts)
         )
     else:
         # termination, when given, is the sole stopping rule

@@ -187,7 +187,7 @@ def _select_better(improved, new_params: GPParams, best_params: GPParams) -> GPP
     jax.jit,
     static_argnames=(
         "kernel", "n_starts", "n_iter", "ard", "rel_jitter",
-        "mesh", "model_axis",
+        "mesh", "model_axis", "convergence_tol", "convergence_check_every",
     ),
 )
 def fit_gp_batch(
@@ -206,6 +206,8 @@ def fit_gp_batch(
     train_mask: Optional[jax.Array] = None,
     mesh=None,
     model_axis: str = "model",
+    convergence_tol: Optional[float] = 1e-4,
+    convergence_check_every: int = 20,
 ) -> GPFit:
     """Fit d independent GPs with S random restarts each, as one program.
 
@@ -214,6 +216,14 @@ def fit_gp_batch(
     reference model.py:1419-1753). `train_mask` (N,) marks real rows when X/Y
     are bucket-padded to a static shape (see `_pad_to_bucket`); masked fits
     are exactly the unpadded fits.
+
+    `convergence_tol` enables the in-graph analogue of the reference
+    SCE-UA's convergence stop (model.py:1579-1596 `peps` criterion): the
+    Adam scan runs in chunks of `convergence_check_every` steps inside a
+    `lax.while_loop`, stopping once a whole chunk improves the summed
+    best NMLL by less than `tol * max(1, |nmll|)` — no host syncs, and
+    easy fits stop in a fraction of `n_iter`. `None` restores the fixed
+    `n_iter`-step scan.
 
     With a `mesh` carrying a `model_axis` whose size divides `n_starts`,
     the restart axis of the whole Adam scan is sharded over that axis
@@ -292,9 +302,56 @@ def fit_gp_batch(
         params = optax.apply_updates(params, updates)
         return (params, opt_state, best_params, best_vals), None
 
-    (_, _, params, final), _ = jax.lax.scan(
-        step, (params0, opt_state0, params0, inf0), None, length=n_iter
+    carry0 = (params0, opt_state0, params0, inf0)
+    # None disables convergence stopping; tol == 0.0 is a real tolerance
+    # ("stop only when no cell improved at all")
+    chunk = (
+        max(1, min(convergence_check_every, n_iter))
+        if convergence_tol is not None
+        else n_iter
     )
+    if convergence_tol is None or chunk >= n_iter:
+        (_, _, params, final), _ = jax.lax.scan(
+            step, carry0, None, length=n_iter
+        )
+    else:
+
+        tol = jnp.asarray(convergence_tol, dt)
+        n_full, rem = divmod(n_iter, chunk)
+
+        def cond(c):
+            *_, best_vals, i, prev_vals = c
+            # per-cell improvement over the last chunk; inf -> finite is
+            # inf (still improving), inf -> inf is nan (not improving) —
+            # the loop runs while ANY (restart, objective) cell moves
+            delta = prev_vals - best_vals
+            improving = jnp.any(
+                delta > tol * jnp.maximum(1.0, jnp.abs(best_vals))
+            )
+            # i == 0: both sides are inf (delta nan) — always run chunk 1
+            return (i < n_full) & ((i == 0) | improving)
+
+        def body(c):
+            params, opt_state, best_params, best_vals, i, _ = c
+            inner, _ = jax.lax.scan(
+                step, (params, opt_state, best_params, best_vals), None,
+                length=chunk,
+            )
+            return (*inner, i + 1, best_vals)
+
+        carry = jax.lax.while_loop(
+            cond, body, (*carry0, jnp.asarray(0, jnp.int32), inf0)
+        )
+        params_c, opt_state_c, params, final, i_done, _ = carry
+        if rem:
+            # only a run that exhausted every chunk without converging
+            # still owes the remainder steps (exact n_iter semantics)
+            params_c, opt_state_c, params, final = jax.lax.cond(
+                i_done == n_full,
+                lambda c: jax.lax.scan(step, c, None, length=rem)[0],
+                lambda c: c,
+                (params_c, opt_state_c, params, final),
+            )
     best = jnp.argmin(final, axis=0)  # (d,)
 
     take = lambda arr: jnp.take_along_axis(
@@ -592,6 +649,8 @@ class GPR_Matern(SurrogateMixin):
         learning_rate: float = 0.1,
         dtype="float32",
         rel_jitter: Optional[float] = None,
+        convergence_tol: Optional[float] = 1e-4,
+        convergence_check_every: int = 20,
         mesh=None,
         logger=None,
         **kwargs,
@@ -621,6 +680,8 @@ class GPR_Matern(SurrogateMixin):
             learning_rate=learning_rate,
             ard=bool(anisotropic),
             rel_jitter=rel_jitter,
+            convergence_tol=convergence_tol,
+            convergence_check_every=convergence_check_every,
             mesh=mesh,
         )
         self.fit = fit._replace(

@@ -148,7 +148,13 @@ class NSGA2(MOEA):
             pool_idx = tournament_selection(
                 k_pool, poolsize, state.rank, mask=active
             )
-            pool_n = jnp.clip(state.n_active // 2, 2, poolsize)
+            # the pool holds min(n_active, poolsize) live entries (masked
+            # Gumbel top-k); clamp by the live count so a tiny
+            # min_population_size (< 4) can never make i1/i2 reach a dead
+            # slot — at n_active == 1 both parents degenerate to slot 0
+            pool_n = jnp.minimum(
+                jnp.clip(state.n_active // 2, 2, poolsize), state.n_active
+            )
         else:
             pool_idx = tournament_selection(k_pool, poolsize, state.rank)
             pool_n = poolsize

@@ -42,7 +42,10 @@ def launch_loopback_cluster(
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # concurrent ranks must not share a persistent compilation cache
+    # (DMOSOPT_TPU_CACHE_DIR is the driver.run() opt-in that would
+    # otherwise re-point every rank at one directory)
     env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.pop("DMOSOPT_TPU_CACHE_DIR", None)
     flags = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()
         if "xla_force_host_platform_device_count" not in f

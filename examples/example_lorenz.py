@@ -91,7 +91,7 @@ if __name__ == "__main__":
         "random_seed": 0,
     }
 
-    best = dmosopt_tpu.run(dmosopt_params, verbose=True)
+    best = dmosopt_tpu.run(dmosopt_params, compile_cache_dir=".jax_example_cache", verbose=True)
     prms, lres = best
     p_best = np.column_stack([v for _, v in prms])
     err = np.column_stack([v for _, v in lres]).sum(axis=1)

@@ -75,7 +75,7 @@ if __name__ == "__main__":
         "n_epochs": 3,
         "random_seed": 7,
         "mesh": mesh,
-    })
+    }, compile_cache_dir=".jax_example_cache")
     prms, lres = best
     y = np.column_stack([v for _, v in lres])
     print(f"{len(y)} non-dominated points from the sharded run")

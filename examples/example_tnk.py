@@ -37,7 +37,7 @@ if __name__ == "__main__":
         "random_seed": 1,
     }
 
-    best = dmosopt_tpu.run(dmosopt_params, verbose=True, return_constraints=True)
+    best = dmosopt_tpu.run(dmosopt_params, compile_cache_dir=".jax_example_cache", verbose=True, return_constraints=True)
     prms, lres, lconstr = best
     c = np.column_stack([v for _, v in lconstr])
     print(f"{c.shape[0]} best points, all feasible: {bool(np.all(c > 0))}")

@@ -42,7 +42,7 @@ if __name__ == "__main__":
         "random_seed": 42,
     }
 
-    best = dmosopt_tpu.run(dmosopt_params, verbose=True)
+    best = dmosopt_tpu.run(dmosopt_params, compile_cache_dir=".jax_example_cache", verbose=True)
     prms, lres = best
     y = np.column_stack([v for _, v in lres])
     front = zdt1_pareto(500)

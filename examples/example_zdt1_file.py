@@ -42,8 +42,8 @@ if __name__ == "__main__":
         "random_seed": 21,
     }
 
-    dmosopt_tpu.run(dmosopt_params, verbose=True)
+    dmosopt_tpu.run(dmosopt_params, compile_cache_dir=".jax_example_cache", verbose=True)
     print("first run complete; resuming 2 more epochs from results/zdt1.h5")
-    best = dmosopt_tpu.run(dmosopt_params, verbose=True)
+    best = dmosopt_tpu.run(dmosopt_params, compile_cache_dir=".jax_example_cache", verbose=True)
     print("analyze with: python -m dmosopt_tpu.cli analyze "
           "-p results/zdt1.h5 --opt-id dmosopt_zdt1_file --knn 5")

@@ -37,5 +37,5 @@ if __name__ == "__main__":
         "random_seed": 3,
     }
 
-    best = dmosopt_tpu.run(dmosopt_params, verbose=True)
+    best = dmosopt_tpu.run(dmosopt_params, compile_cache_dir=".jax_example_cache", verbose=True)
     print("done;", len(best[0][0][1]), "best points")

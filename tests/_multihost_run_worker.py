@@ -1,0 +1,95 @@
+"""Worker for the multi-host END-TO-END `run()` test: one JAX process of
+a loopback cluster driving the PUBLIC `dmosopt_tpu.run()` entry point —
+full epoch loop, surrogate fits, archive updates, and rank-0-only H5
+checkpoint writes — over a mesh spanning every process's devices, so the
+run's collectives cross the process boundary (the loopback equivalent of
+the reference's `mpirun -n K` full runs, dmosopt.py:2518-2536).
+
+Every rank saves its final best set to `<out_dir>/best_rank<r>.npz`; the
+launching test compares them against a same-seed single-process run.
+
+Usage: python _multihost_run_worker.py <coordinator> <num_procs> <proc_id> <out_dir>
+"""
+
+import os
+import sys
+
+
+def main():
+    coordinator, num_procs, proc_id, out_dir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from dmosopt_tpu.parallel.mesh import create_mesh, initialize_distributed
+
+    rank = initialize_distributed(
+        coordinator_address=coordinator,
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    n_global = jax.device_count()
+
+    import numpy as np
+
+    import dmosopt_tpu
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+
+    mesh = create_mesh(axis_names=("pop",))  # spans ALL processes' devices
+    assert mesh.devices.size == n_global
+
+    h5_path = os.path.join(out_dir, "multihost_run.h5")
+    params = multihost_run_params(zdt1, mesh=mesh, file_path=h5_path)
+    best = dmosopt_tpu.run(params, verbose=False)
+    prms, lres = best
+    best_x = np.column_stack([v for _, v in prms])
+    best_y = np.column_stack([v for _, v in lres])
+
+    np.savez(
+        os.path.join(out_dir, f"best_rank{rank}.npz"), x=best_x, y=best_y
+    )
+    # only the primary process may have created/written the checkpoint
+    wrote_h5 = os.path.isfile(h5_path)
+    print(
+        f"MULTIHOST_RUN_OK rank={rank} global_devices={n_global} "
+        f"n_best={best_y.shape[0]} h5={wrote_h5}",
+        flush=True,
+    )
+
+
+def multihost_run_params(obj_fun, mesh=None, file_path=None):
+    """One config, shared verbatim by the cluster ranks and the
+    single-process comparator so the equivalence check compares exactly
+    the same run."""
+    params = {
+        "opt_id": "multihost_run",
+        "obj_fun": obj_fun,
+        "jax_objective": True,
+        "objective_names": ["f1", "f2"],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(6)},
+        "problem_parameters": {},
+        "n_initial": 4,
+        "n_epochs": 2,
+        "population_size": 16,
+        "num_generations": 8,
+        "resample_fraction": 0.5,
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 20, "seed": 0},
+        "random_seed": 21,
+    }
+    if mesh is not None:
+        params["mesh"] = mesh
+    if file_path is not None:
+        params["file_path"] = file_path
+        params["save"] = True
+    return params
+
+
+if __name__ == "__main__":
+    main()

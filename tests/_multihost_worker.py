@@ -57,22 +57,30 @@ def main():
         x0, y0, dim, 2, np.zeros(dim), np.ones(dim),
         seed=0, n_starts=2, n_iter=10,
     )
-    opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=None)
-    opt.initialize_strategy(
-        x0, y0, np.stack([np.zeros(dim), np.ones(dim)], 1), random=0
-    )
+    def run_epoch(use_mesh):
+        o = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=None)
+        o.initialize_strategy(
+            x0, y0, np.stack([np.zeros(dim), np.ones(dim)], 1), random=0
+        )
+        gen = moasmo.optimize(
+            2, o, Model(objective=sm), dim, 2,
+            np.zeros(dim), np.ones(dim),
+            popsize=pop, local_random=1, mesh=use_mesh,
+        )
+        try:
+            next(gen)
+            raise AssertionError("surrogate-mode optimize must not yield")
+        except StopIteration as ex:
+            return ex.value
 
-    gen = moasmo.optimize(
-        2, opt, Model(objective=sm), dim, 2,
-        np.zeros(dim), np.ones(dim),
-        popsize=pop, local_random=1, mesh=mesh,
+    # equivalence, not just finiteness: the DCN-spanning sharded epoch
+    # must reproduce the replicated single-process epoch (same seeds)
+    res = run_epoch(mesh)
+    res_repl = run_epoch(None)
+    np.testing.assert_allclose(res.y, res_repl.y, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        res.best_y, res_repl.best_y, rtol=1e-4, atol=1e-4
     )
-    try:
-        next(gen)
-        raise AssertionError("surrogate-mode optimize must not yield")
-    except StopIteration as ex:
-        res = ex.value
-    assert np.all(np.isfinite(res.best_y))
     print(f"MULTIHOST_OK rank={rank} global_devices={n_global}", flush=True)
 
 

@@ -118,6 +118,40 @@ def test_grows_and_expands_capacity(cls):
     assert opt.state.rank.shape[0] == opt.capacity
 
 
+def test_capacity_growth_training_set_has_no_padded_duplicates():
+    """After a mid-run capacity growth the epoch's accumulated training
+    set (EpochResults.x / gen_index) must contain only real, distinct
+    evaluations: per-generation widths reflect the true pre-/post-growth
+    offspring counts (not one padded rectangle), and no duplicated rows
+    flow toward archives or surrogate training."""
+
+    def thin_front(X):
+        s = jnp.sum(X, axis=1)
+        q = jnp.sum((X - 0.05) ** 2, axis=1)
+        return jnp.stack([s, q], axis=1)
+
+    opt = NSGA2(
+        popsize=16, nInput=DIM, nOutput=2, model=None,
+        adaptive_population_size=True, min_population_size=8,
+        max_population_size=48,
+    )
+    res = _drive(opt, thin_front, 30)
+    assert opt.capacity > 16, "capacity never grew"
+
+    counts = np.bincount(res.gen_index)
+    assert res.x.shape[0] == res.gen_index.shape[0] == counts.sum()
+    widths = counts[1:]  # gen_index 0 is the initial sample
+    # pre-growth generations are narrower than post-growth ones; a padded
+    # rectangle would report one uniform width everywhere
+    assert widths.min() == 16
+    assert widths.max() > 16
+    # every accumulated offspring row is distinct (padding duplicated the
+    # last offspring of each narrow generation)
+    n0 = int(counts[0])
+    new_rows = res.x[n0:]
+    assert np.unique(new_rows, axis=0).shape[0] == new_rows.shape[0]
+
+
 def test_default_off_is_unchanged():
     """With the default (off), state carries n_active == popsize and the
     whole population is returned — bitwise-identical behavior."""

@@ -96,3 +96,45 @@ def test_dryrun_multichip_wall_clock_budget():
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "VIRTUAL CPU mesh" in proc.stdout
     assert wall < 420, f"dryrun took {wall:.0f}s"
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_survives_wedged_probe(tmp_path):
+    """The driver-real failure mode that cost rounds 3 AND 4: no
+    JAX_PLATFORMS short-circuit, so dryrun_multichip pays the real
+    backend probe — and the probe child hangs at interpreter startup
+    (a sitecustomize stall, like the wedged accelerator tunnel) while
+    holding a grandchild on the stdout pipe (the process that blocked
+    round 4's post-kill communicate()). The run must kill the probe's
+    process group at its deadline, respawn on the virtual mesh with a
+    COLD compile cache, and finish inside the driver's ~600 s budget
+    with progress lines localizing every stage."""
+    site = tmp_path / "fakeaxon_site"  # "axon" in basename -> stripped
+    site.mkdir()                       # from the respawned child's path
+    (site / "sitecustomize.py").write_text(
+        "import os, subprocess, sys, time\n"
+        "if os.environ.get('_DMOSOPT_TPU_PROBE'):\n"
+        "    subprocess.Popen([sys.executable, '-c',\n"
+        "                      'import time; time.sleep(600)'])\n"
+        "    time.sleep(600)\n"
+    )
+    cold_cache = tmp_path / "cold_cache"
+    env = _clean_env()  # no JAX_PLATFORMS: the real probe path runs
+    env["PYTHONPATH"] = REPO + os.pathsep + str(site)
+    env["DMOSOPT_TPU_CACHE_DIR"] = str(cold_cache)
+    env["DMOSOPT_DRYRUN_PROBE_TIMEOUT"] = "20"  # keep the test brisk
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=450,
+    )
+    wall = time.time() - t0
+    tail = proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert proc.returncode == 0, tail
+    assert "probe timed out" in proc.stdout, tail
+    assert "VIRTUAL CPU mesh" in proc.stdout, tail
+    # stage lines must localize progress for a post-mortem tail read
+    assert "[dryrun-child]" in proc.stdout, tail
+    assert "sharded batch evaluator OK" in proc.stdout, tail
+    assert wall < 450, f"wedged-probe dryrun took {wall:.0f}s"

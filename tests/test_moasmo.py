@@ -232,9 +232,9 @@ def test_host_loop_escape_hatch_for_non_scannable_optimizer():
     opt.initialize_strategy(X[:pop], Y[:pop], bounds, random=0)
     eval_fn = moasmo._surrogate_eval_fn(Model(objective=sm))
 
-    x_traj, y_traj, n_gen = moasmo._optimize_on_device(
+    x_new, y_new, gen_counts = moasmo._optimize_on_device(
         opt, eval_fn, num_generations=4, key=jax.random.PRNGKey(0)
     )
-    assert n_gen == 4
-    assert x_traj.shape[0] == 4 and x_traj.shape[2] == dim
-    assert np.all(np.isfinite(y_traj))
+    assert len(gen_counts) == 4
+    assert x_new.shape == (int(gen_counts.sum()), dim)
+    assert np.all(np.isfinite(y_new))

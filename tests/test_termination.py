@@ -198,13 +198,13 @@ def test_resource_aware_eval_budget_stops_mid_run():
 
     t = ResourceAwareTermination(Prob(), max_function_evals=budget)
     assert t.eval_budget() == budget
-    x_traj, y_traj, n_gen = moasmo._optimize_on_device(
+    x_new, y_new, gen_counts = moasmo._optimize_on_device(
         opt, zdt1, 100, jax.random.PRNGKey(0),
         termination=t, termination_check_interval=50,
     )
-    n_eval = x_traj.shape[0] * x_traj.shape[1]
+    n_eval = x_new.shape[0]
     assert n_eval == budget, (n_eval, budget)
-    assert n_gen == 5
+    assert len(gen_counts) == 5
 
     # the budget also propagates through a composite collection
     coll = TerminationCollection(
@@ -270,13 +270,13 @@ def test_resource_aware_eval_budget_never_overshoots():
     opt.initialize_strategy(x0, y0, bounds, random=1)
 
     t = ResourceAwareTermination(Prob(), max_function_evals=budget)
-    x_traj, _, n_gen = moasmo._optimize_on_device(
+    x_new, _, gen_counts = moasmo._optimize_on_device(
         opt, zdt1, 100, jax.random.PRNGKey(0),
         termination=t, termination_check_interval=50,
     )
-    n_eval = x_traj.shape[0] * x_traj.shape[1]
+    n_eval = x_new.shape[0]
     assert n_eval == 4 * pop, (n_eval, budget)
-    assert n_gen == 4
+    assert len(gen_counts) == 4
     # the stop is attributed to the budget criterion even though no
     # evaluation ever reached the cap
     assert t.stop_reasons() == ["ResourceAwareTermination"]
@@ -285,8 +285,8 @@ def test_resource_aware_eval_budget_never_overshoots():
     opt2 = NSGA2(popsize=pop, nInput=4, nOutput=2, model=None)
     opt2.initialize_strategy(x0, y0, bounds, random=1)
     t2 = ResourceAwareTermination(Prob(), max_function_evals=pop - 1)
-    x_traj2, _, n_gen2 = moasmo._optimize_on_device(
+    x_new2, _, gen_counts2 = moasmo._optimize_on_device(
         opt2, zdt1, 100, jax.random.PRNGKey(0),
         termination=t2, termination_check_interval=50,
     )
-    assert n_gen2 == 0 and x_traj2.shape[0] == 0
+    assert len(gen_counts2) == 0 and x_new2.shape[0] == 0

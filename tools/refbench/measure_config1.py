@@ -1,0 +1,56 @@
+"""Re-measure the config-1 reference constants (bench.py
+REFERENCE_CPU_GENS_PER_SEC / REFERENCE_CPU_GP_FIT_SEC): the reference's
+NSGA2 strategy loop (generate/update per generation, pop=200 dim=30 on
+raw ZDT1) and a GPR_Matern + SCE-UA fit on N=200 — same methodology as
+BASELINE.md "Measured" (drive the strategy directly, no MPI).
+
+Run: env PYTHONPATH=$PWD:/root/reference JAX_PLATFORMS=cpu python measure_config1.py
+"""
+import json
+import time
+
+import numpy as np
+
+from dmosopt.MOEA import Struct
+from dmosopt.NSGA2 import NSGA2
+from dmosopt.model import GPR_Matern
+
+
+def zdt1(x):
+    f1 = x[0]
+    g = 1.0 + 9.0 / (len(x) - 1) * np.sum(x[1:])
+    return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
+
+
+def main():
+    dim, pop, ngen = 30, 200, 60
+    rng = np.random.default_rng(42)
+    x0 = rng.uniform(size=(pop, dim))
+    y0 = np.apply_along_axis(zdt1, 1, x0)
+    bounds = np.column_stack([np.zeros(dim), np.ones(dim)])
+
+    model = Struct(feasibility=None)
+    opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=model)
+    opt.initialize_strategy(x0, y0, bounds, local_random=rng)
+    t0 = time.perf_counter()
+    for _ in range(ngen):
+        x_gen, state = opt.generate()
+        y_gen = np.apply_along_axis(zdt1, 1, x_gen)
+        opt.update(x_gen, y_gen, state)
+    gens_per_sec = ngen / (time.perf_counter() - t0)
+
+    xin = rng.uniform(size=(200, dim))
+    yin = np.apply_along_axis(zdt1, 1, xin)
+    t0 = time.perf_counter()
+    GPR_Matern(xin, yin, dim, 2, np.zeros(dim), np.ones(dim))
+    gp_fit_sec = time.perf_counter() - t0
+
+    print(json.dumps({
+        "gens_per_sec": round(gens_per_sec, 2),
+        "gp_fit_sec": round(gp_fit_sec, 2),
+        "ngen": ngen,
+    }))
+
+
+if __name__ == "__main__":
+    main()
