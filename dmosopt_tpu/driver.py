@@ -244,6 +244,16 @@ class DistOptimizer:
             if self._resuming
             else None
         )
+        if self._resuming and restored is None:
+            # a rank that silently fell through to the fresh path would
+            # diverge from the primary's control flow and deadlock the
+            # cluster inside a collective — fail loudly instead (e.g.
+            # checkpoint not on a shared filesystem)
+            raise FileNotFoundError(
+                f"resume decided (primary sees {file_path!r}) but this "
+                f"process cannot read it — is the checkpoint on a "
+                f"shared filesystem?"
+            )
         self.old_evals = {}
         self.start_epoch = 0
         if restored is not None:

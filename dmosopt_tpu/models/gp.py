@@ -220,10 +220,12 @@ def fit_gp_batch(
     `convergence_tol` enables the in-graph analogue of the reference
     SCE-UA's convergence stop (model.py:1579-1596 `peps` criterion): the
     Adam scan runs in chunks of `convergence_check_every` steps inside a
-    `lax.while_loop`, stopping once a whole chunk improves the summed
-    best NMLL by less than `tol * max(1, |nmll|)` — no host syncs, and
-    easy fits stop in a fraction of `n_iter`. `None` restores the fixed
-    `n_iter`-step scan.
+    `lax.while_loop`, stopping once a whole chunk fails to improve ANY
+    objective's winning (min-over-restarts) best NMLL by more than
+    `tol * max(1, |nmll|)`. The winner is what the fit returns — a
+    losing restart still wandering does not keep the loop alive. No host
+    syncs; easy fits stop in a fraction of `n_iter`. `None` restores the
+    fixed `n_iter`-step scan.
 
     With a `mesh` carrying a `model_axis` whose size divides `n_starts`,
     the restart axis of the whole Adam scan is sharded over that axis
@@ -304,7 +306,7 @@ def fit_gp_batch(
 
     carry0 = (params0, opt_state0, params0, inf0)
     # None disables convergence stopping; tol == 0.0 is a real tolerance
-    # ("stop only when no cell improved at all")
+    # ("stop only when no objective's winner improved at all")
     chunk = (
         max(1, min(convergence_check_every, n_iter))
         if convergence_tol is not None
@@ -319,15 +321,18 @@ def fit_gp_batch(
         tol = jnp.asarray(convergence_tol, dt)
         n_full, rem = divmod(n_iter, chunk)
 
+        def _winner(best_vals):
+            # what the fit returns: the best restart per objective. A
+            # losing restart still wandering must not keep the loop alive.
+            return jnp.min(best_vals, axis=0)  # (d,)
+
         def cond(c):
-            *_, best_vals, i, prev_vals = c
-            # per-cell improvement over the last chunk; inf -> finite is
-            # inf (still improving), inf -> inf is nan (not improving) —
-            # the loop runs while ANY (restart, objective) cell moves
-            delta = prev_vals - best_vals
-            improving = jnp.any(
-                delta > tol * jnp.maximum(1.0, jnp.abs(best_vals))
-            )
+            *_, best_vals, i, prev_win = c
+            win = _winner(best_vals)
+            # inf -> finite improvement is inf (still improving);
+            # inf -> inf is nan (not improving)
+            delta = prev_win - win
+            improving = jnp.any(delta > tol * jnp.maximum(1.0, jnp.abs(win)))
             # i == 0: both sides are inf (delta nan) — always run chunk 1
             return (i < n_full) & ((i == 0) | improving)
 
@@ -337,10 +342,11 @@ def fit_gp_batch(
                 step, (params, opt_state, best_params, best_vals), None,
                 length=chunk,
             )
-            return (*inner, i + 1, best_vals)
+            return (*inner, i + 1, _winner(best_vals))
 
         carry = jax.lax.while_loop(
-            cond, body, (*carry0, jnp.asarray(0, jnp.int32), inf0)
+            cond, body,
+            (*carry0, jnp.asarray(0, jnp.int32), jnp.full((d,), jnp.inf, dt)),
         )
         params_c, opt_state_c, params, final, i_done, _ = carry
         if rem:

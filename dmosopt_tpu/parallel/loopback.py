@@ -62,15 +62,31 @@ def launch_loopback_cluster(
     ]
     env["PYTHONPATH"] = os.pathsep.join([repo] + keep)
 
+    # each rank gets its own session so a timeout can kill its whole
+    # process group — a pipe-holding grandchild of a wedged rank would
+    # otherwise block the post-kill communicate() unboundedly (the
+    # round-4 evidence-artifact failure mode; see _procutil.py)
     procs = [
         subprocess.Popen(
             [sys.executable, worker_script, coordinator,
              str(n_processes), str(pid), *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
+            text=True, start_new_session=True,
         )
         for pid in range(n_processes)
     ]
+
+    def _kill_group(p):
+        import signal
+
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                p.kill()
+            except OSError:
+                pass
+
     results: dict = {}
     deadline = time.time() + timeout
     try:
@@ -80,13 +96,18 @@ def launch_loopback_cluster(
             results[i] = (p.returncode, out)
     except subprocess.TimeoutExpired:
         for p in procs:
-            p.kill()
+            _kill_group(p)
         # collect only the ranks that had not completed; completed ranks
         # keep their real output (no duplicates, no re-communicate)
         for i, p in enumerate(procs):
             if i in results:
                 continue
-            out, _ = p.communicate()
+            try:
+                out, _ = p.communicate(timeout=5)
+            except subprocess.TimeoutExpired:
+                out = ""
+                if p.stdout is not None:
+                    p.stdout.close()
             results[i] = (
                 p.returncode, f"[TIMEOUT after {timeout}s]\n{out}"
             )
