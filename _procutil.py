@@ -54,7 +54,7 @@ def kill_process_group(proc: "subprocess.Popen") -> None:
     killing the direct child alone."""
     try:
         os.killpg(proc.pid, signal.SIGKILL)
-    except (ProcessLookupError, PermissionError, OSError):
+    except OSError:
         try:
             proc.kill()
         except OSError:

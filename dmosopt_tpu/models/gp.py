@@ -206,8 +206,8 @@ def fit_gp_batch(
     train_mask: Optional[jax.Array] = None,
     mesh=None,
     model_axis: str = "model",
-    convergence_tol: Optional[float] = 1e-4,
-    convergence_check_every: int = 20,
+    convergence_tol: Optional[float] = 1e-3,
+    convergence_check_every: int = 10,
 ) -> GPFit:
     """Fit d independent GPs with S random restarts each, as one program.
 
@@ -655,8 +655,8 @@ class GPR_Matern(SurrogateMixin):
         learning_rate: float = 0.1,
         dtype="float32",
         rel_jitter: Optional[float] = None,
-        convergence_tol: Optional[float] = 1e-4,
-        convergence_check_every: int = 20,
+        convergence_tol: Optional[float] = 1e-3,
+        convergence_check_every: int = 10,
         mesh=None,
         logger=None,
         **kwargs,

@@ -81,7 +81,7 @@ def launch_loopback_cluster(
 
         try:
             os.killpg(p.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError, OSError):
+        except OSError:
             try:
                 p.kill()
             except OSError:
@@ -95,8 +95,12 @@ def launch_loopback_cluster(
             out, _ = p.communicate(timeout=max(0.1, deadline - time.time()))
             results[i] = (p.returncode, out)
     except subprocess.TimeoutExpired:
-        for p in procs:
-            _kill_group(p)
+        # kill only the ranks still running: a completed rank's pid may
+        # already be recycled, and killpg (unlike Popen.kill) has no
+        # reaped-child guard
+        for i, p in enumerate(procs):
+            if i not in results:
+                _kill_group(p)
         # collect only the ranks that had not completed; completed ranks
         # keep their real output (no duplicates, no re-communicate)
         for i, p in enumerate(procs):
@@ -108,6 +112,10 @@ def launch_loopback_cluster(
                 out = ""
                 if p.stdout is not None:
                     p.stdout.close()
+                try:
+                    p.wait(timeout=5)  # reap; avoids rc=None zombies
+                except subprocess.TimeoutExpired:
+                    pass
             results[i] = (
                 p.returncode, f"[TIMEOUT after {timeout}s]\n{out}"
             )
