@@ -183,6 +183,65 @@ def _select_better(improved, new_params: GPParams, best_params: GPParams) -> GPP
     return GPParams(*(pick(n, b) for n, b in zip(new_params, best_params)))
 
 
+def _scan_with_convergence(step, carry0, n_iter, convergence_tol,
+                           convergence_check_every, winner_fn, dt):
+    """Run `lax.scan(step)` for up to `n_iter` iterations, checking a
+    convergence criterion every `convergence_check_every` steps inside a
+    `lax.while_loop`: stop once a whole chunk fails to improve any
+    component of `winner_fn(best_vals)` (the quantity the fit returns)
+    by more than `tol * max(1, |winner|)`. The carry layout is fixed:
+    (params, opt_state, best_params, best_vals). inf -> finite
+    improvements count as improving (delta inf); inf -> inf is nan (not
+    improving); the first chunk always runs. `convergence_tol=None`
+    restores the fixed-length scan; `n_iter` stays the hard cap (a
+    non-converged run still owes the remainder steps)."""
+    chunk = (
+        max(1, min(convergence_check_every, n_iter))
+        if convergence_tol is not None
+        else n_iter
+    )
+    if convergence_tol is None or chunk >= n_iter:
+        carry, _ = jax.lax.scan(step, carry0, None, length=n_iter)
+        return carry
+
+    tol = jnp.asarray(convergence_tol, dt)
+    n_full, rem = divmod(n_iter, chunk)
+    win0 = winner_fn(carry0[3])
+
+    def cond(c):
+        *_, best_vals, i, prev_win = c
+        win = winner_fn(best_vals)
+        delta = prev_win - win
+        improving = jnp.any(delta > tol * jnp.maximum(1.0, jnp.abs(win)))
+        # i == 0: both sides are inf (delta nan) — always run chunk 1
+        return (i < n_full) & ((i == 0) | improving)
+
+    def body(c):
+        params, opt_state, best_params, best_vals, i, _ = c
+        inner, _ = jax.lax.scan(
+            step, (params, opt_state, best_params, best_vals), None,
+            length=chunk,
+        )
+        return (*inner, i + 1, winner_fn(best_vals))
+
+    carry = jax.lax.while_loop(
+        cond, body,
+        (*carry0, jnp.asarray(0, jnp.int32), jnp.full_like(win0, jnp.inf)),
+    )
+    *inner, i_done, _ = carry
+    inner = tuple(inner)
+    if rem:
+        # only a run that exhausted every chunk without converging
+        # still owes the remainder steps (exact n_iter semantics)
+        inner = jax.lax.cond(
+            i_done == n_full,
+            lambda c: jax.lax.scan(step, c, None, length=rem)[0],
+            lambda c: c,
+            inner,
+        )
+    return inner
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -304,60 +363,14 @@ def fit_gp_batch(
         params = optax.apply_updates(params, updates)
         return (params, opt_state, best_params, best_vals), None
 
-    carry0 = (params0, opt_state0, params0, inf0)
-    # None disables convergence stopping; tol == 0.0 is a real tolerance
-    # ("stop only when no objective's winner improved at all")
-    chunk = (
-        max(1, min(convergence_check_every, n_iter))
-        if convergence_tol is not None
-        else n_iter
+    # the winner is what the fit returns — the best restart per
+    # objective; a losing restart still wandering must not keep the
+    # loop alive. tol None disables stopping; 0.0 is a real tolerance.
+    _, _, params, final = _scan_with_convergence(
+        step, (params0, opt_state0, params0, inf0), n_iter,
+        convergence_tol, convergence_check_every,
+        lambda best_vals: jnp.min(best_vals, axis=0), dt,
     )
-    if convergence_tol is None or chunk >= n_iter:
-        (_, _, params, final), _ = jax.lax.scan(
-            step, carry0, None, length=n_iter
-        )
-    else:
-
-        tol = jnp.asarray(convergence_tol, dt)
-        n_full, rem = divmod(n_iter, chunk)
-
-        def _winner(best_vals):
-            # what the fit returns: the best restart per objective. A
-            # losing restart still wandering must not keep the loop alive.
-            return jnp.min(best_vals, axis=0)  # (d,)
-
-        def cond(c):
-            *_, best_vals, i, prev_win = c
-            win = _winner(best_vals)
-            # inf -> finite improvement is inf (still improving);
-            # inf -> inf is nan (not improving)
-            delta = prev_win - win
-            improving = jnp.any(delta > tol * jnp.maximum(1.0, jnp.abs(win)))
-            # i == 0: both sides are inf (delta nan) — always run chunk 1
-            return (i < n_full) & ((i == 0) | improving)
-
-        def body(c):
-            params, opt_state, best_params, best_vals, i, _ = c
-            inner, _ = jax.lax.scan(
-                step, (params, opt_state, best_params, best_vals), None,
-                length=chunk,
-            )
-            return (*inner, i + 1, _winner(best_vals))
-
-        carry = jax.lax.while_loop(
-            cond, body,
-            (*carry0, jnp.asarray(0, jnp.int32), jnp.full((d,), jnp.inf, dt)),
-        )
-        params_c, opt_state_c, params, final, i_done, _ = carry
-        if rem:
-            # only a run that exhausted every chunk without converging
-            # still owes the remainder steps (exact n_iter semantics)
-            params_c, opt_state_c, params, final = jax.lax.cond(
-                i_done == n_full,
-                lambda c: jax.lax.scan(step, c, None, length=rem)[0],
-                lambda c: c,
-                (params_c, opt_state_c, params, final),
-            )
     best = jnp.argmin(final, axis=0)  # (d,)
 
     take = lambda arr: jnp.take_along_axis(
@@ -385,7 +398,13 @@ def fit_gp_batch(
                  train_mask=tm)
 
 
-@partial(jax.jit, static_argnames=("kernel", "n_starts", "n_iter", "rel_jitter"))
+@partial(
+    jax.jit,
+    static_argnames=(
+        "kernel", "n_starts", "n_iter", "rel_jitter",
+        "convergence_tol", "convergence_check_every",
+    ),
+)
 def fit_gp_shared(
     key: jax.Array,
     X: jax.Array,  # (N, n) unit box
@@ -399,11 +418,16 @@ def fit_gp_shared(
     learning_rate: float = 0.1,
     rel_jitter: Optional[float] = None,
     train_mask: Optional[jax.Array] = None,
+    convergence_tol: Optional[float] = 1e-3,
+    convergence_check_every: int = 10,
 ) -> GPFit:
     """Joint multi-output fit: ONE shared ARD kernel for all d objectives,
     optimized on the summed exact MLL (the statistical coupling of the
     reference's multitask GP, model_gpytorch.py:1623-1926, without its
-    Kronecker task covariance). Posterior stays per-objective."""
+    Kronecker task covariance). Posterior stays per-objective.
+    Convergence stopping follows `fit_gp_batch`: the loop exits once a
+    whole chunk fails to improve the winning (min-over-restarts) summed
+    MLL."""
     N, n = X.shape
     if train_mask is not None:
         Y = Y * train_mask[:, None].astype(Y.dtype)
@@ -466,11 +490,11 @@ def fit_gp_shared(
         params = optax.apply_updates(params, updates)
         return (params, opt_state, best_params, best_vals), None
 
-    (_, _, params, vals), _ = jax.lax.scan(
+    _, _, params, vals = _scan_with_convergence(
         step,
-        (params0, opt.init(params0), params0, jnp.full((n_starts,), jnp.inf, dt)),
-        None,
-        length=n_iter,
+        (params0, opt.init(params0), params0,
+         jnp.full((n_starts,), jnp.inf, dt)),
+        n_iter, convergence_tol, convergence_check_every, jnp.min, dt,
     )
     best = jnp.argmin(vals)
     amp = b_amp.forward(params.u_amp[best])
@@ -752,6 +776,8 @@ class MEGP_Matern(SurrogateMixin):
         n_starts: int = 8,
         n_iter: int = 300,
         learning_rate: float = 0.1,
+        convergence_tol: Optional[float] = 1e-3,
+        convergence_check_every: int = 10,
         logger=None,
         **kwargs,
     ):
@@ -774,6 +800,8 @@ class MEGP_Matern(SurrogateMixin):
             n_starts=n_starts,
             n_iter=n_iter,
             learning_rate=learning_rate,
+            convergence_tol=convergence_tol,
+            convergence_check_every=convergence_check_every,
         )
         self.fit = fit._replace(
             y_mean=jnp.asarray(y_mean, jnp.float32),

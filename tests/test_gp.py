@@ -212,3 +212,53 @@ def test_large_n_reroute_filters_gpr_kwargs():
     assert isinstance(m, SVGP_Matern)
     mu, var = m.predict(X[:5])
     assert np.all(np.isfinite(np.asarray(mu)))
+
+
+def test_scan_with_convergence_semantics():
+    """The shared in-graph convergence harness (_scan_with_convergence):
+    early exit when the winner stops improving, exact n_iter semantics
+    when it never converges (remainder steps included), and tol=None
+    reproducing the fixed-length scan bit for bit."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmosopt_tpu.models.gp import _scan_with_convergence
+
+    # carry layout contract: (params, opt_state, best_params, best_vals)
+    def make_step(decrement):
+        def step(carry, _):
+            params, opt_state, best_params, best_vals = carry
+            params = params + 1.0  # iteration counter in disguise
+            vals = best_vals - decrement(params)
+            return (params, opt_state, best_params, jnp.minimum(vals, best_vals)), None
+
+        return step
+
+    z = jnp.zeros(())
+    v0 = jnp.asarray([10.0, 10.0])
+
+    # steadily improving: never converges -> runs all n_iter steps,
+    # including the remainder chunk (27 = 2 full chunks of 10 + 7)
+    step = make_step(lambda p: 1.0)
+    p, _, _, vals = _scan_with_convergence(
+        step, (z, z, z, v0), 27, 1e-3, 10, jnp.min, jnp.float32
+    )
+    assert float(p) == 27.0
+    np.testing.assert_allclose(np.asarray(vals), 10.0 - 27.0)
+
+    # improvement collapses after step 10 -> stops after chunk 2 (the
+    # chunk that observed no winner movement), far short of n_iter=1000
+    step = make_step(lambda p: jnp.where(p <= 10.0, 1.0, 0.0))
+    p, _, _, _ = _scan_with_convergence(
+        step, (z, z, z, v0), 1000, 1e-3, 10, jnp.min, jnp.float32
+    )
+    assert float(p) == 20.0
+
+    # tol=None: fixed-length scan, identical to lax.scan
+    step = make_step(lambda p: jnp.where(p <= 10.0, 1.0, 0.0))
+    p_none, _, _, vals_none = _scan_with_convergence(
+        step, (z, z, z, v0), 50, None, 10, jnp.min, jnp.float32
+    )
+    ref, _ = jax.lax.scan(step, (z, z, z, v0), None, length=50)
+    assert float(p_none) == 50.0
+    np.testing.assert_array_equal(np.asarray(vals_none), np.asarray(ref[3]))
