@@ -262,3 +262,16 @@ def test_scan_with_convergence_semantics():
     ref, _ = jax.lax.scan(step, (z, z, z, v0), None, length=50)
     assert float(p_none) == 50.0
     np.testing.assert_array_equal(np.asarray(vals_none), np.asarray(ref[3]))
+
+
+def test_auto_convergence_defaults_resolve_by_objective_count():
+    """The quality-critical default resolution: bi-objective fits get the
+    fast pair, anything above gets the strict pair (DTLZ7-m5 final HV
+    collapses under every faster combination — BASELINE.md)."""
+    from dmosopt_tpu.models.gp import _resolve_convergence_defaults
+
+    assert _resolve_convergence_defaults(2, "auto", None) == (1e-3, 10)
+    assert _resolve_convergence_defaults(5, "auto", None) == (1e-4, 20)
+    # explicit values pass through untouched, including None (disabled)
+    assert _resolve_convergence_defaults(5, None, 7) == (None, 7)
+    assert _resolve_convergence_defaults(2, 0.01, None) == (0.01, 10)
