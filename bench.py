@@ -230,6 +230,40 @@ def bench_tnk():
     }
 
 
+# Config-4 definitions, shared with tests/test_benchmarks.py's DTLZ7
+# quality-floor test so the pinned floor always matches the measured
+# workload. Fixed HV reference points keep HV comparable across
+# frameworks/runs (reference-archive HVs at these points: dtlz2
+# 208903.12, dtlz7 10.37 — measured 2026-07-29, see BASELINE.md).
+# Plain lists, converted at use: this module must import without numpy.
+DTLZ_HV_REFS = {
+    "dtlz2": ([12.0] * 5, 208903.12),
+    "dtlz7": ([1.0, 1.0, 1.0, 1.0, 40.0], 10.37),
+}
+
+
+def dtlz_bench_params(prob, opt_id=None):
+    """The config-4 run() parameter dict, minus `obj_fun` (callers add
+    `get_problem(prob, 5)` — building it here would import jax)."""
+    return {
+        "opt_id": opt_id or f"bench_{prob}_m5",
+        "jax_objective": True,
+        "objective_names": [f"f{i+1}" for i in range(5)],
+        "space": {f"x{i:03d}": [0.0, 1.0] for i in range(100)},
+        "problem_parameters": {},
+        "n_initial": 2,
+        "n_epochs": 2,
+        "population_size": 100,
+        "num_generations": 50,
+        "resample_fraction": 0.25,
+        "optimizer_name": "age",
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 4, "n_iter": 100, "seed": 0},
+        "termination_conditions": True,
+        "random_seed": 42,
+    }
+
+
 def bench_dtlz_many_objective():
     """Config 4: DTLZ2/DTLZ7, 5 objectives, dim=100, HV-progress
     termination (exercises the FPRAS estimator via the HV router)."""
@@ -238,42 +272,17 @@ def bench_dtlz_many_objective():
     from dmosopt_tpu.benchmarks.moo_benchmarks import get_problem
     from dmosopt_tpu.hv import AdaptiveHyperVolume
 
-    # fixed reference points so HV is comparable across frameworks/runs
-    # (reference-archive HVs at these points: dtlz2 208903.12,
-    # dtlz7 10.37 — measured 2026-07-29, see BASELINE.md)
-    HV_REFS = {
-        "dtlz2": (np.full(5, 12.0), 208903.12),
-        "dtlz7": (np.array([1.0, 1.0, 1.0, 1.0, 40.0]), 10.37),
-    }
     out = {}
     for prob in ("dtlz2", "dtlz7"):
-        fn = get_problem(prob, 5)
-        params = {
-            "opt_id": f"bench_{prob}_m5",
-            "obj_fun": fn,
-            "jax_objective": True,
-            "objective_names": [f"f{i+1}" for i in range(5)],
-            "space": {f"x{i:03d}": [0.0, 1.0] for i in range(100)},
-            "problem_parameters": {},
-            "n_initial": 2,
-            "n_epochs": 2,
-            "population_size": 100,
-            "num_generations": 50,
-            "resample_fraction": 0.25,
-            "optimizer_name": "age",
-            "surrogate_method_name": "gpr",
-            "surrogate_method_kwargs": {"n_starts": 4, "n_iter": 100, "seed": 0},
-            "termination_conditions": True,
-            "random_seed": 42,
-        }
+        params = dict(dtlz_bench_params(prob), obj_fun=get_problem(prob, 5))
         t0 = time.time()
         dmosopt_tpu.run(params, verbose=False)
         wall = time.time() - t0
         from dmosopt_tpu.driver import dopt_dict
 
         y = dopt_dict[params["opt_id"]].optimizer_dict[0].y
-        ref, ref_hv = HV_REFS[prob]
-        hv = AdaptiveHyperVolume(ref, epsilon=0.02)
+        ref, ref_hv = DTLZ_HV_REFS[prob]
+        hv = AdaptiveHyperVolume(np.asarray(ref), epsilon=0.02)
         final_hv = float(hv.compute_hypervolume(y))
         key = f"{prob}_5obj_dim100"
         out[key] = {

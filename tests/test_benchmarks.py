@@ -92,3 +92,33 @@ def test_wfg_high_objective_count_robust():
     x = np.full((4, n_var), 0.5) * 2 * np.arange(1, n_var + 1)
     f = np.asarray(fn(x.astype(np.float32)))
     assert f.shape == (4, 5) and np.all(np.isfinite(f))
+
+
+@pytest.mark.slow
+def test_dtlz7_m5_archive_quality_floor():
+    """Pin the quality cliff that motivated the objective-count-resolved
+    GP convergence defaults: bench config 4's DTLZ7-m5 run (shared
+    params from bench.py — fixed surrogate budget n_starts=4 n_iter=100,
+    with the d-resolved convergence `auto` defaults flowing through)
+    must reach final HV >= 10.0 at the fixed reference point (10.3244
+    measured; any convergence pair faster than the strict (1e-4, 20)
+    collapses it to ~8.88 — BASELINE.md round-5)."""
+    import dmosopt_tpu
+    from bench import DTLZ_HV_REFS, dtlz_bench_params
+    from dmosopt_tpu.benchmarks.moo_benchmarks import get_problem
+    from dmosopt_tpu.driver import dopt_dict
+    from dmosopt_tpu.hv import AdaptiveHyperVolume
+
+    params = dict(
+        dtlz_bench_params("dtlz7", opt_id="quality_floor_dtlz7"),
+        obj_fun=get_problem("dtlz7", 5),
+    )
+    dmosopt_tpu.run(params, verbose=False)
+    y = dopt_dict[params["opt_id"]].optimizer_dict[0].y
+    ref, _ = DTLZ_HV_REFS["dtlz7"]
+    hv = AdaptiveHyperVolume(np.asarray(ref), epsilon=0.02)
+    final_hv = float(hv.compute_hypervolume(y))
+    assert final_hv >= 10.0, (
+        f"DTLZ7-m5 final HV {final_hv:.4f} below the 10.0 floor — "
+        f"surrogate-fit accuracy regressed (see BASELINE.md round-5)"
+    )

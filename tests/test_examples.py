@@ -7,23 +7,31 @@ first-ever invocation pays cold compiles)."""
 import os
 import subprocess
 import sys
+import types
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# conftest.py puts the repo root on sys.path
+from _procutil import axon_free_pythonpath, communicate_bounded
 
-sys.path.insert(0, REPO)
-from _procutil import axon_free_pythonpath  # noqa: E402
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_example(name, timeout=900):
+    """Run an example in its own session with a process-group-killed
+    deadline (_procutil): a wedged example with a pipe-holding helper
+    child must fail at the deadline, not hang the slow suite."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = axon_free_pythonpath(REPO)
-    return subprocess.run(
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "examples", name)],
-        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True,
     )
+    out, err, rc = communicate_bounded(proc, timeout)
+    assert rc != "timeout", f"{name} exceeded {timeout}s; tail:\n{out[-2000:]}"
+    return types.SimpleNamespace(returncode=rc, stdout=out, stderr=err)
 
 
 @pytest.mark.slow

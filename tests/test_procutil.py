@@ -1,0 +1,63 @@
+"""Unit tests for the process-group-safe subprocess helpers shared by
+the repo-root orchestrators (bench.py, __graft_entry__.py). The
+round-4 evidence artifact died on exactly the hazard these guard: a
+killed child whose grandchild holds the stdout pipe and blocks the
+post-kill communicate() forever."""
+
+import os
+import subprocess
+import sys
+import time
+
+# conftest.py puts the repo root on sys.path
+from _procutil import axon_free_pythonpath, communicate_bounded, run_probe
+
+
+def test_communicate_bounded_normal_exit():
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "print('hello')"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    out, err, rc = communicate_bounded(proc, 30)
+    assert rc == 0 and out.strip() == "hello"
+
+
+def test_communicate_bounded_kills_pipe_holding_grandchild():
+    """The round-4 failure mode: the child spawns a grandchild that
+    inherits the stdout pipe and sleeps, then the child itself hangs.
+    communicate_bounded must return 'timeout' promptly (process-group
+    kill takes the grandchild down too) instead of blocking on the
+    still-open pipe."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import subprocess, sys, time\n"
+         "subprocess.Popen([sys.executable, '-c', 'import time; time.sleep(120)'])\n"
+         "time.sleep(120)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    t0 = time.time()
+    _, _, rc = communicate_bounded(proc, 2)
+    wall = time.time() - t0
+    assert rc == "timeout"
+    assert wall < 15, f"bounded communicate took {wall:.0f}s"
+    assert proc.returncode is not None  # reaped, no zombie
+
+
+def test_run_probe_tags_and_times_out():
+    out, rc = run_probe("import os; print('TAG=' + os.environ['_DMOSOPT_TPU_PROBE'])", 30)
+    assert rc == 0 and "TAG=1" in out
+    t0 = time.time()
+    _, rc = run_probe("import time; time.sleep(60)", 2)
+    assert rc == "timeout"
+    assert time.time() - t0 < 15
+
+
+def test_axon_free_pythonpath_strips_and_prepends():
+    joined = os.pathsep.join(["/x/lib", "/y/fakeaxon_site", "/z"])
+    out = axon_free_pythonpath("/repo", joined)
+    parts = out.split(os.pathsep)
+    assert parts[0] == "/repo"
+    assert "/y/fakeaxon_site" not in parts
+    assert "/x/lib" in parts and "/z" in parts
