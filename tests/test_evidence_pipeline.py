@@ -55,6 +55,21 @@ def test_env_forced_cpu_devices_parsing():
                 os.environ[k] = v
 
 
+def test_entry_is_jittable_and_runs():
+    """The driver compile-checks `entry()` single-chip; mirror that here:
+    the returned step must jit, execute on its example args, and produce
+    a finite next state."""
+    import jax
+    import numpy as np
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    state = jax.jit(fn)(*args)
+    pop_obj = np.asarray(state.population_obj)
+    assert np.all(np.isfinite(pop_obj)), "entry() step produced non-finite objectives"
+
+
 @pytest.mark.slow
 def test_bench_emits_json_even_with_broken_backend():
     """bench.py orchestration: a default env whose backend init FAILS
