@@ -5,6 +5,7 @@ devices through one sharded surrogate epoch (reference capability:
 program over DCN instead of an MPI task farm)."""
 
 import os
+import sys
 
 import pytest
 
@@ -12,6 +13,19 @@ from dmosopt_tpu.parallel.loopback import launch_loopback_cluster
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "_multihost_worker.py")
+RUN_WORKER = os.path.join(REPO, "tests", "_multihost_run_worker.py")
+if os.path.join(REPO, "tests") not in sys.path:
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def _assert_cluster_ok(results, marker):
+    """Common rank-result check: skip when the CPU backend can't do
+    multi-process, else every rank must exit 0 and print `marker`."""
+    for rc, out in results:
+        if rc != 0 and "does not support" in out.lower():
+            pytest.skip(f"multi-process CPU backend unavailable:\n{out[-500:]}")
+        assert rc == 0, out[-3000:]
+        assert marker in out, out[-3000:]
 
 
 @pytest.mark.slow
@@ -23,12 +37,50 @@ def test_two_process_dcn_loopback():
         WORKER, n_processes=num_procs, devices_per_process=devs_per_proc,
         timeout=600,
     )
-    for rc, out in results:
-        if rc != 0 and "does not support" in out.lower():
-            pytest.skip(f"multi-process CPU backend unavailable:\n{out[-500:]}")
-        assert rc == 0, out[-3000:]
-        assert "MULTIHOST_OK" in out, out[-3000:]
+    _assert_cluster_ok(results, "MULTIHOST_OK")
+    for _, out in results:
         assert f"global_devices={num_procs * devs_per_proc}" in out
+
+
+@pytest.mark.slow
+def test_multihost_resume_from_existing_checkpoint(tmp_path):
+    """Cluster resume end-to-end: a single-process run writes the
+    checkpoint, then a 2-process cluster runs the same config — both
+    ranks take the resume path (the broadcast True branch executes;
+    note the loopback filesystem is shared, so a non-primary rank's own
+    isfile() would agree anyway — the divergence-under-unshared-fs case
+    is covered by the loud FileNotFoundError in driver.py, not here),
+    append new epochs with advancing labels, and agree on the result."""
+    import h5py
+    import numpy as np
+
+    import dmosopt_tpu
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+    from _multihost_run_worker import multihost_run_params
+
+    h5_path = tmp_path / "multihost_run.h5"
+    params = multihost_run_params(zdt1, file_path=str(h5_path))
+    dmosopt_tpu.run(params, verbose=False)
+    with h5py.File(h5_path, "r") as f:
+        n_before = f["multihost_run/0/parameters"].shape[0]
+        e_before = int(np.asarray(f["multihost_run/0/epochs"]).max())
+
+    results = launch_loopback_cluster(
+        RUN_WORKER, n_processes=2, devices_per_process=4, timeout=600,
+        extra_args=(str(tmp_path),),
+    )
+    _assert_cluster_ok(results, "MULTIHOST_RUN_OK")
+
+    with h5py.File(h5_path, "r") as f:
+        n_after = f["multihost_run/0/parameters"].shape[0]
+        e_after = int(np.asarray(f["multihost_run/0/epochs"]).max())
+    assert n_after > n_before, (n_before, n_after)
+    assert e_after > e_before, (e_before, e_after)
+
+    # SPMD: the resumed cluster ranks agree on the final archive
+    r0 = np.load(tmp_path / "best_rank0.npz")
+    r1 = np.load(tmp_path / "best_rank1.npz")
+    np.testing.assert_array_equal(r0["y"], r1["y"])
 
 
 @pytest.mark.slow
@@ -40,18 +92,11 @@ def test_multihost_public_run_end_to_end_equivalence(tmp_path):
     `mpirun -n K`, dmosopt.py:2518-2536)."""
     import numpy as np
 
-    run_worker = os.path.join(REPO, "tests", "_multihost_run_worker.py")
-    num_procs, devs_per_proc = 2, 4
     results = launch_loopback_cluster(
-        run_worker, n_processes=num_procs,
-        devices_per_process=devs_per_proc, timeout=600,
+        RUN_WORKER, n_processes=2, devices_per_process=4, timeout=600,
         extra_args=(str(tmp_path),),
     )
-    for rc, out in results:
-        if rc != 0 and "does not support" in out.lower():
-            pytest.skip(f"multi-process CPU backend unavailable:\n{out[-500:]}")
-        assert rc == 0, out[-3000:]
-        assert "MULTIHOST_RUN_OK" in out, out[-3000:]
+    _assert_cluster_ok(results, "MULTIHOST_RUN_OK")
 
     # rank 0 wrote the checkpoint; it must be a loadable schema
     h5_path = tmp_path / "multihost_run.h5"
@@ -78,13 +123,10 @@ def test_multihost_public_run_end_to_end_equivalence(tmp_path):
 
     if jax.device_count() < 8:
         pytest.skip("needs the 8-virtual-device test process")
-    import sys
 
     import dmosopt_tpu
     from dmosopt_tpu.benchmarks.zdt import zdt1
     from dmosopt_tpu.parallel.mesh import create_mesh
-
-    sys.path.insert(0, os.path.join(REPO, "tests"))
     from _multihost_run_worker import multihost_run_params
 
     params = multihost_run_params(
