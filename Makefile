@@ -14,6 +14,11 @@ test-slow:
 test-all:
 	$(PY) -m pytest tests/ -q -m ""
 
+# every metric name emitted in the package must be cataloged in
+# docs/observability.md (also enforced inside the fast suite)
+lint-metrics:
+	$(PY) tools/lint_metrics.py
+
 bench:
 	python bench.py
 

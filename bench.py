@@ -54,7 +54,13 @@ def _ensure_jax():
 
 # Config-1 constants re-measured 2026-07-30 (round 5) via
 # tools/refbench/measure_config1.py; 07-29 values (20.38 / 8.12 s)
-# reproduced within ~10%.
+# reproduced within ~10%. NOTE: these were single-shot measurements;
+# measure_config1.py is now best-of-2 (matching bench_zdt1_nsga2's
+# methodology, warm-up + min-of-2) — re-bake from its output next time
+# the reference environment is available so the headline ratio is
+# min-of-2 on both sides. Until then the baked reference numbers can
+# only understate the reference (flattering our ratio by ≤ the ~30%
+# scheduling noise), never overstate it.
 REFERENCE_CPU_GENS_PER_SEC = 20.66  # reference dmosopt NSGA2, this host CPU
 REFERENCE_CPU_GP_FIT_SEC = 7.27  # reference GPR_Matern + SCE-UA, N=200
 

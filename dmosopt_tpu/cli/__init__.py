@@ -1,4 +1,4 @@
-"""Command-line tools: analyze / train / onestep.
+"""Command-line tools: analyze / train / onestep / telemetry.
 
 Capability match: the reference ships three click commands —
 `dmosopt-analyze` (Pareto extraction + kNN-to-origin ranking,
@@ -6,7 +6,9 @@ dmosopt_analyze.py:39-160), `dmosopt-train` (offline surrogate fitting
 from stored evals, dmosopt_train.py), and `dmosopt-onestep` (one
 resample step from a store, dmosopt_onestep.py). The reference CLIs are
 stale against their own store API (SURVEY §3.5); these implement the
-same intent against the dmosopt_tpu HDF5 schema.
+same intent against the dmosopt_tpu HDF5 schema. `telemetry` is new:
+it renders the per-epoch observability summaries the driver persists
+(docs/observability.md) as a phase/throughput table.
 """
 
 from __future__ import annotations
@@ -290,6 +292,107 @@ def onestep(file_path, opt_id, problem_id, population_size, num_generations,
             )
 
 
+_TELEMETRY_PHASES = ("xinit", "train", "optimize", "eval")
+
+
+def _fmt(v, width, nd=2):
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:.{nd}f}".rjust(width)
+    return str(v).rjust(width)
+
+
+@click.command("telemetry")
+@click.option("--file-path", "-p", required=True, type=click.Path(exists=True))
+@click.option("--opt-id", required=True, type=str)
+@click.option("--problem-id", default=0, type=int,
+              help="problem whose archive feeds the --hv trajectory")
+@click.option("--hv/--no-hv", "with_hv", default=False,
+              help="add a cumulative archive-hypervolume column "
+                   "(computed from the stored evaluations per epoch)")
+@click.option("--output-file", "-o", type=click.Path(), default=None,
+              help="also export the summaries (plus hv) as JSON")
+def telemetry(file_path, opt_id, problem_id, with_hv, output_file):
+    """Per-epoch telemetry table from a results store: phase durations,
+    EA throughput, eval-time stats, surrogate-fit results — the
+    summaries the driver persists into the HDF5 `telemetry` group
+    (docs/observability.md)."""
+    from dmosopt_tpu.storage import load_telemetry_from_h5
+
+    summaries = load_telemetry_from_h5(file_path, opt_id)
+    if not summaries:
+        raise click.ClickException(
+            f"no telemetry group for opt id {opt_id!r} in {file_path} "
+            f"(run with telemetry enabled and save=True)"
+        )
+
+    hv_by_epoch = {}
+    if with_hv:
+        raw, _ = _load(file_path, opt_id)
+        entries = raw["evals"].get(problem_id, [])
+        if entries:
+            from dmosopt_tpu.hv import (
+                AdaptiveHyperVolume,
+                default_reference_point,
+            )
+
+            x, y, f, c, epochs = _stack_evals(entries)
+            # one fixed reference point over the full archive keeps the
+            # trajectory comparable across epochs
+            engine = AdaptiveHyperVolume(default_reference_point(y))
+            for e in sorted(summaries):
+                m = epochs <= e
+                if not m.any():
+                    continue
+                best = moasmo.get_best(
+                    x[m], y[m], None, c[m] if c is not None else None,
+                    x.shape[1], y.shape[1],
+                )
+                if best[1].shape[0] > 0:
+                    hv_by_epoch[e] = float(
+                        engine.compute_hypervolume(best[1])
+                    )
+
+    header = (
+        f"{'epoch':>5} {'wall_s':>8} "
+        + " ".join(f"{p:>9}" for p in _TELEMETRY_PHASES)
+        + f" {'gens':>6} {'gens/s':>8} {'evals':>6} {'eval_mean':>9}"
+        + (f" {'hv':>10}" if with_hv else "")
+    )
+    click.echo(header)
+    click.echo("-" * len(header))
+    for e in sorted(summaries):
+        s = summaries[e]
+        phases = s.get("phases", {})
+        ev = s.get("eval", {})
+        line = (
+            _fmt(e, 5)
+            + " " + _fmt(s.get("wall_s"), 8)
+            + " " + " ".join(_fmt(phases.get(p), 9, 3) for p in _TELEMETRY_PHASES)
+            + " " + _fmt(s.get("n_generations"), 6)
+            + " " + _fmt(s.get("gens_per_sec"), 8)
+            + " " + _fmt(ev.get("eval_n"), 6)
+            + " " + _fmt(ev.get("eval_mean"), 9, 4)
+        )
+        if with_hv:
+            line += " " + _fmt(hv_by_epoch.get(e), 10, 4)
+        click.echo(line)
+
+    if output_file is not None:
+        payload = {
+            str(e): (
+                dict(summaries[e], hypervolume=hv_by_epoch.get(e))
+                if with_hv
+                else summaries[e]
+            )
+            for e in sorted(summaries)
+        }
+        with open(output_file, "w") as fh:
+            json.dump(payload, fh, indent=2)
+        click.echo(f"wrote {output_file}")
+
+
 @click.group()
 def cli():
     """dmosopt-tpu command-line tools."""
@@ -298,6 +401,7 @@ def cli():
 cli.add_command(analyze)
 cli.add_command(train)
 cli.add_command(onestep)
+cli.add_command(telemetry)
 
 
 def main():  # console entry point

@@ -22,6 +22,7 @@ reference semantics.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import os
@@ -42,7 +43,9 @@ from dmosopt_tpu.datatypes import (
 )
 from dmosopt_tpu.parallel.evaluator import HostFunEvaluator, JaxBatchEvaluator
 from dmosopt_tpu.strategy import DistOptStrategy
+from dmosopt_tpu.telemetry import Telemetry, create_telemetry, record_device_memory
 from dmosopt_tpu.utils.prng import as_generator
+from dmosopt_tpu.utils.profiling import device_trace, eval_time_stats
 
 logger = logging.getLogger(__name__)
 
@@ -151,6 +154,8 @@ class DistOptimizer:
         metadata=None,
         # execution backend (TPU-specific)
         jax_objective=False, evaluator=None, n_eval_workers=1, mesh=None,
+        # observability
+        telemetry=None,
         verbose=False,
         **kwargs,
     ) -> None:
@@ -166,6 +171,12 @@ class DistOptimizer:
             axis over the mesh's first axis, SPMD with XLA collectives)
             and, with jax_objective, the batch evaluation.
           n_eval_workers: thread-pool width for host objectives.
+          telemetry: None/True for the on-by-default metrics + event log,
+            False for none at all (zero telemetry calls on the hot
+            path), a dict of `dmosopt_tpu.telemetry.Telemetry` kwargs
+            (ring_size, jsonl_path, profile_dir, profile_epochs, ...),
+            or a ready-made Telemetry instance — see
+            docs/observability.md.
         """
         if random_seed is not None:
             if local_random is not None:
@@ -219,6 +230,11 @@ class DistOptimizer:
         )
         self.save_surrogate_evals_ = save_surrogate_evals
         self.save_optimizer_params_ = save_optimizer_params
+        self.telemetry = create_telemetry(telemetry)
+        # a pass-through user instance may be shared across runs (one
+        # JSONL sink for a sweep); only instances created here are
+        # closed by `run()`
+        self._owns_telemetry = not isinstance(telemetry, Telemetry)
         self.start_time = time.time()
 
         self.logger = logging.getLogger(opt_id)
@@ -254,6 +270,10 @@ class DistOptimizer:
                 f"process cannot read it — is the checkpoint on a "
                 f"shared filesystem?"
             )
+        if self._resuming:
+            # every rank has finished READING the checkpoint before any
+            # rank may append to it (see _barrier_after_restore)
+            self._barrier_after_restore()
         self.old_evals = {}
         self.start_epoch = 0
         if restored is not None:
@@ -295,6 +315,7 @@ class DistOptimizer:
 
         # run-progress counters and per-problem registries
         self.epoch_count = self.saved_eval_count = self.eval_count = 0
+        self.save_count = 0
         self.optimizer_dict, self.storage_dict, self.stats = {}, {}, {}
 
         # the archive holds features as flat float columns (see
@@ -370,6 +391,13 @@ class DistOptimizer:
             if jax_objective
             else HostFunEvaluator(self.eval_fun, n_workers=n_eval_workers)
         )
+        if self.telemetry is not None:
+            # backends report batch dispatch/compile/execute splits;
+            # external evaluators may not carry the attribute — skip them
+            try:
+                self.evaluator.telemetry = self.telemetry
+            except AttributeError:
+                pass
 
         if (
             self.save and file_path is not None
@@ -392,9 +420,11 @@ class DistOptimizer:
     def _broadcast_resume_decision(file_path) -> bool:
         """Whether this run restores from `file_path`. Single-process:
         a plain isfile() check. Multi-process: the primary's answer is
-        broadcast so every rank takes the same branch — and the
-        collective doubles as a barrier that keeps non-primary ranks
-        from racing rank 0's init_h5 write."""
+        broadcast so every rank takes the same branch. The broadcast
+        alone only serializes the DECISION — the read-vs-append race on
+        the checkpoint itself is closed by the paired barrier in
+        `_barrier_after_restore`, which runs after every rank finishes
+        `_restore_from_file`."""
         exists = file_path is not None and os.path.isfile(file_path)
         import jax
 
@@ -412,6 +442,27 @@ class DistOptimizer:
                 _np.asarray(exists, dtype=_np.bool_)
             )
         )
+
+    @staticmethod
+    def _barrier_after_restore():
+        """Barrier after all ranks complete `_restore_from_file`: h5py
+        without SWMR gives a reader no consistency guarantee against a
+        concurrent writer, and a resumed run whose programs contain no
+        cross-process collectives (e.g. no cluster-spanning mesh) would
+        otherwise let rank 0 finish its restore and start appending
+        evaluations while a slower rank is still reading the file.
+        No-op in single-process runs."""
+        import jax
+
+        try:
+            multi = jax.process_count() > 1
+        except Exception:
+            multi = False
+        if not multi:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dmosopt_tpu_restore_complete")
 
     @staticmethod
     def _check_persistence_config(file_path, save, problem_parameters, space):
@@ -513,7 +564,7 @@ class DistOptimizer:
         "feasibility_method_name", "feasibility_method_kwargs",
         "termination_conditions", "optimize_mean_variance",
         "local_random", "logger", "file_path", "mesh",
-        "persist_features",
+        "persist_features", "telemetry",
     )
 
     def _strategy_spec(self):
@@ -527,26 +578,32 @@ class DistOptimizer:
             self.eval_fun, logger=self.logger,
         )
         spec = self._strategy_spec()
-        any_restored = False
-        initial_complete = False
-        for problem_id in self.problem_ids:
-            initial = self._restored_initial(problem_id)
-            initial_complete = initial_complete or (
-                initial is not None
-                and initial[1].shape[0]
-                >= self.n_initial * len(self.param_names)
-            )
-            any_restored = any_restored or initial is not None
-            self.optimizer_dict[problem_id] = DistOptStrategy(
-                opt_prob, n_initial=self.n_initial, initial=initial, **spec
-            )
-            self.storage_dict[problem_id] = []
-        if initial_complete:
+        initials = {
+            problem_id: self._restored_initial(problem_id)
+            for problem_id in self.problem_ids
+        }
+        any_restored = any(init is not None for init in initials.values())
+        if any(
+            init is not None
+            and init[1].shape[0] >= self.n_initial * len(self.param_names)
+            for init in initials.values()
+        ):
             # a completed initial design means the restored max epoch is
             # done: new epochs continue AFTER it. One increment for the
             # whole run — not one per problem (problems share epoch
             # numbering; per-problem increments left gaps in the labels)
             self.start_epoch += 1
+        for problem_id in self.problem_ids:
+            self.optimizer_dict[problem_id] = DistOptStrategy(
+                opt_prob, n_initial=self.n_initial,
+                initial=initials[problem_id],
+                # telemetry tags the xinit phase with the run's first
+                # epoch so a resumed run's summary keeps it (epoch-0
+                # events are pruned once set_epoch advances past them)
+                xinit_epoch=self.start_epoch,
+                **spec,
+            )
+            self.storage_dict[problem_id] = []
         if any_restored:
             self.print_best()
 
@@ -595,6 +652,11 @@ class DistOptimizer:
                 self.file_path, self.logger,
                 surrogate_mean_variance=self.optimize_mean_variance,
             )
+            # save-trigger accounting is per-rank: non-primary ranks
+            # stay at 0, which is exactly their share of the writes
+            self.save_count += 1
+            if self.telemetry:
+                self.telemetry.inc("h5_saves_total")
 
     def save_surrogate_evals(self, problem_id, epoch, gen_index, x_sm, y_sm):
         if x_sm.shape[0] > 0 and _is_primary_process():
@@ -624,6 +686,19 @@ class DistOptimizer:
         save_stats_to_h5(
             self.opt_id, problem_id, epoch, self.file_path, self.logger,
             self.get_stats(),
+        )
+
+    def save_telemetry(self, epoch):
+        """Persist this epoch's telemetry summary into the HDF5
+        `telemetry` group (process-0 only, like every other write) so a
+        resumed run keeps the full per-epoch history."""
+        if self.telemetry is None or not self.save or not _is_primary_process():
+            return
+        from dmosopt_tpu.storage import save_telemetry_to_h5
+
+        save_telemetry_to_h5(
+            self.opt_id, epoch, self.telemetry.epoch_summary(epoch),
+            self.file_path, self.logger,
         )
 
     # ------------------------------------------------------------ queries
@@ -697,6 +772,10 @@ class DistOptimizer:
         round gathers one request per problem id (so multi-problem tasks
         share an evaluation call, matching eval_obj_fun_mp), batches all
         rounds, and evaluates them in one backend call."""
+        tel = self.telemetry
+        t_drain0 = time.perf_counter()
+        evals_before = self.eval_count
+        round_times = []
         has_requests = any(
             self.optimizer_dict[pid].has_requests() for pid in self.problem_ids
         )
@@ -735,6 +814,7 @@ class DistOptimizer:
                         else self.reduce_fun(res, *self.reduce_fun_args)
                     )
                 t = res.pop("time", -1.0) if isinstance(res, dict) else -1.0
+                round_times.append(t)
                 for problem_id, rres in res.items():
                     eval_req = round_reqs[problem_id]
                     kwargs = {}
@@ -781,6 +861,19 @@ class DistOptimizer:
         if self.save and self.saved_eval_count < self.eval_count:
             self.save_evals()
             self.saved_eval_count = self.eval_count
+
+        # one `eval` phase event per NON-EMPTY drain (polling calls that
+        # found no requests stay silent), carrying the reference-style
+        # per-eval wall-clock aggregates
+        if tel and self.eval_count > evals_before:
+            n_new = self.eval_count - evals_before
+            dt = time.perf_counter() - t_drain0
+            tel.inc("evals_total", n_new)
+            tel.observe("phase_duration_seconds", dt, phase="eval")
+            tel.event(
+                "phase", phase="eval", duration_s=dt, n_evals=n_new,
+                **eval_time_stats(round_times),
+            )
 
         return self.eval_count, self.saved_eval_count
 
@@ -847,42 +940,69 @@ class DistOptimizer:
 
     def run_epoch(self, completed_epoch: bool = False):
         """One full epoch: drain initial requests, run per-problem epoch
-        state machines to completion (reference dmosopt.py:1341-1470)."""
+        state machines to completion (reference dmosopt.py:1341-1470).
+
+        With telemetry enabled the epoch is bracketed by an `epoch`
+        event (wall time, cumulative eval/save counts), device-memory
+        gauges are refreshed, and — when the telemetry config names a
+        `profile_dir` covering this epoch — the whole epoch body runs
+        under a `jax.profiler` device trace."""
         epoch = self.start_epoch + self.epoch_count
         advance_epoch = (self.epoch_count + 1) < self.n_epochs
 
-        self.stats["init_sampling_start"] = time.time()
-        self._process_requests()
-        for strat in self.optimizer_dict.values():
-            if self.dynamic_initial_sampling is not None and self.epoch_count == 0:
-                self._drain_dynamic_initial_samples(strat)
-            strat.initialize_epoch(epoch)
-        self.stats["init_sampling_end"] = time.time()
+        tel = self.telemetry
+        t_epoch0 = time.perf_counter()
+        trace_ctx = contextlib.nullcontext()
+        if tel:
+            tel.set_epoch(epoch)
+            record_device_memory(tel)
+            if tel.should_trace(epoch):
+                trace_ctx = device_trace(tel.profile_dir)
+                tel.event("trace", profile_dir=tel.profile_dir)
 
-        # every problem must finish its own epoch state machine; problems
-        # that complete early stop being polled while the rest catch up
-        pending = set() if completed_epoch else set(self.problem_ids)
-        while pending:
-            if self._time_exceeded():
-                # soft stop (reference dmosopt.py:1165-1168): pending
-                # requests are abandoned; state saved so far is kept
-                self.logger.warning("time limit exceeded; stopping epoch")
-                break
+        with trace_ctx:
+            self.stats["init_sampling_start"] = time.time()
             self._process_requests()
+            for strat in self.optimizer_dict.values():
+                if self.dynamic_initial_sampling is not None and self.epoch_count == 0:
+                    self._drain_dynamic_initial_samples(strat)
+                strat.initialize_epoch(epoch)
+            self.stats["init_sampling_end"] = time.time()
 
-            for problem_id in sorted(pending):
-                state, res, completed_evals = self.optimizer_dict[
-                    problem_id
-                ].update_epoch(resample=advance_epoch)
-                if state == StrategyState.CompletedEpoch:
-                    pending.discard(problem_id)
-                    self._finish_problem_epoch(
-                        problem_id, epoch, advance_epoch, res, completed_evals
-                    )
+            # every problem must finish its own epoch state machine; problems
+            # that complete early stop being polled while the rest catch up
+            pending = set() if completed_epoch else set(self.problem_ids)
+            while pending:
+                if self._time_exceeded():
+                    # soft stop (reference dmosopt.py:1165-1168): pending
+                    # requests are abandoned; state saved so far is kept
+                    self.logger.warning("time limit exceeded; stopping epoch")
+                    break
+                self._process_requests()
+
+                for problem_id in sorted(pending):
+                    state, res, completed_evals = self.optimizer_dict[
+                        problem_id
+                    ].update_epoch(resample=advance_epoch)
+                    if state == StrategyState.CompletedEpoch:
+                        pending.discard(problem_id)
+                        self._finish_problem_epoch(
+                            problem_id, epoch, advance_epoch, res, completed_evals
+                        )
 
         if self.save:
             for problem_id in self.problem_ids:
                 self.save_stats(problem_id, epoch)
+
+        if tel:
+            tel.inc("epochs_total")
+            tel.event(
+                "epoch",
+                duration_s=time.perf_counter() - t_epoch0,
+                eval_count=self.eval_count,
+                save_count=self.save_count,
+            )
+            self.save_telemetry(epoch)
 
         self.epoch_count += 1
         return self.epoch_count
@@ -980,12 +1100,30 @@ def run(
         dopt_params["time_limit"] = time_limit
     dopt = dopt_init(dopt_params, verbose=verbose, initialize_strategy=True)
     dopt.logger.info(f"Optimizing for {dopt.n_epochs} epochs...")
-    if dopt.n_epochs <= 0:
-        dopt.run_epoch(completed_epoch=True)
-    else:
-        while dopt.epoch_count < dopt.n_epochs and not dopt._time_exceeded():
-            dopt.run_epoch()
-    dopt.print_best()
+    try:
+        if dopt.n_epochs <= 0:
+            dopt.run_epoch(completed_epoch=True)
+        else:
+            while dopt.epoch_count < dopt.n_epochs and not dopt._time_exceeded():
+                dopt.run_epoch()
+        dopt.print_best()
+        if dopt.telemetry:
+            # run-end accounting: persistent-cache hit/miss totals (zero
+            # when no cache dir was configured) and a final memory reading
+            from dmosopt_tpu.utils.compile_cache import cache_stats
+
+            cs = cache_stats()
+            dopt.telemetry.gauge("compile_cache_hits", cs["hits"])
+            dopt.telemetry.gauge("compile_cache_misses", cs["misses"])
+            dopt.telemetry.event("compile_cache", **cs)
+            record_device_memory(dopt.telemetry)
+    finally:
+        # only close a Telemetry this run created: a pass-through
+        # user-supplied instance may be shared across runs (one JSONL
+        # sink for a sweep) and closing it would silently drop the
+        # next run's events
+        if dopt.telemetry is not None and dopt._owns_telemetry:
+            dopt.telemetry.close()
     return dopt.get_best(
         feasible=feasible, return_features=return_features,
         return_constraints=return_constraints,

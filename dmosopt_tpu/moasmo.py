@@ -27,6 +27,8 @@ import itertools
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from dmosopt_tpu.telemetry import phase_scope
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -577,11 +579,17 @@ def train(
     logger=None,
     file_path=None,
     mesh=None,
+    info: Optional[Dict[str, Any]] = None,
 ):
     """Fit the objective surrogate on feasible, deduplicated data
     (reference: dmosopt/MOASMO.py:473-532). A `mesh` is forwarded to
     surrogates whose constructor names it (the exact-GP family shards
     its multi-start axis over the mesh's "model" axis when present).
+
+    `info`, when given, is populated with training-set accounting
+    (n_train, duplicates_removed, feasible_fraction, routed surrogate
+    name) plus the fitted model's loss/step summary — the fields the
+    telemetry `train` phase event carries.
 
     Dense-kernel surrogate names (gpr/egp/megp/mdgp/mdspp, plus vgp
     whose inducing set is the full training set) are rerouted
@@ -590,6 +598,7 @@ def train(
     ``LARGE_N_THRESHOLD``; None/0 disables) — see ``_route_large_n``."""
     x = np.asarray(Xinit).copy()
     y = np.asarray(Yinit).copy()
+    n_total = x.shape[0]
 
     feasible, (x, y) = _feasible_subset(C, x, y)
     if logger is not None:
@@ -598,7 +607,14 @@ def train(
         else:
             logger.info(f"Found {len(x)} solutions")
 
+    n_before_dedupe = x.shape[0]
     x, y = remove_duplicates(x, y)
+    if info is not None:
+        if feasible is not None:
+            info["feasible_fraction"] = (
+                round(len(feasible) / n_total, 4) if n_total else 0.0
+            )
+        info["duplicates_removed"] = int(n_before_dedupe - x.shape[0])
 
     kwargs = dict(surrogate_method_kwargs or {})
     threshold = kwargs.pop("large_n_threshold", LARGE_N_THRESHOLD)
@@ -636,10 +652,26 @@ def train(
             if "__init__" in c.__dict__
         ):
             kwargs["mesh"] = mesh
-    return cls(
+    sm = cls(
         x, y, nInput, nOutput, xlb, xub, **kwargs, logger=logger,
         return_mean_variance=surrogate_return_mean_variance,
     )
+    if info is not None:
+        info["n_train"] = int(x.shape[0])
+        info["surrogate"] = (
+            routed_name
+            if isinstance(routed_name, str)
+            else getattr(routed_name, "__name__", str(routed_name))
+        )
+        fit_info = getattr(sm, "fit_info", None) or {}
+        for src, dst in (
+            ("loss", "surrogate_loss"),
+            ("n_steps", "fit_n_steps"),
+            ("early_stopped", "fit_early_stopped"),
+        ):
+            if src in fit_info:
+                info[dst] = fit_info[src]
+    return sm
 
 
 # -------------------------------------------------------------- sensitivity
@@ -712,9 +744,15 @@ def epoch(
     logger=None,
     file_path=None,
     mesh=None,
+    telemetry=None,
 ):
     """One MO-ASMO epoch as a host-side generator
     (reference: dmosopt/MOASMO.py:196-470).
+
+    `telemetry` (a `dmosopt_tpu.telemetry.Telemetry` or None) records the
+    `train` and `optimize` phase events plus the `resample` selection
+    event; None (the disabled default outside the driver) keeps this
+    function free of telemetry calls.
 
     Protocol: if Xinit is None, the first `yield` receives
     `(Xinit, Yinit, C)`. In surrogate mode the epoch then runs entirely
@@ -789,13 +827,15 @@ def epoch(
                 logger.warning(f"Unable to fit feasibility model: {e}")
 
     if surrogate_method_name is not None and mdl.objective is None:
-        mdl.objective = train(
-            nInput, nOutput, xlb, xub, Xinit, Yinit, C,
-            surrogate_method_name=surrogate_method_name,
-            surrogate_method_kwargs=surrogate_method_kwargs,
-            surrogate_return_mean_variance=optimize_mean_variance,
-            logger=logger, file_path=file_path, mesh=mesh,
-        )
+        with phase_scope(telemetry, "train") as ph:
+            mdl.objective = train(
+                nInput, nOutput, xlb, xub, Xinit, Yinit, C,
+                surrogate_method_name=surrogate_method_name,
+                surrogate_method_kwargs=surrogate_method_kwargs,
+                surrogate_return_mean_variance=optimize_mean_variance,
+                logger=logger, file_path=file_path, mesh=mesh,
+                info=ph,
+            )
 
     if sensitivity_method_name is not None and mdl.sensitivity is None:
 
@@ -840,6 +880,12 @@ def epoch(
     # filter out infeasible solutions before seeding the optimizer
     _, (x_0, y_0) = _feasible_subset(C, x_0, y_0)
 
+    # in evaluation mode the generator suspends at `yield` while the
+    # driver evaluates each generation; that wall time is recorded by
+    # the driver as the `eval` phase, so it is subtracted here — the
+    # `optimize` phase and gens_per_sec cover EA compute only
+    t_opt0 = time.perf_counter()
+    t_suspended = 0.0
     opt_gen = optimize(
         num_generations, optimizer, mdl, nInput, nOutput, xlb, xub,
         initial=(x_0, y_0), popsize=pop, local_random=local_random,
@@ -860,7 +906,9 @@ def epoch(
                 raise AssertionError(
                     "surrogate-mode optimize must not yield"
                 )  # pragma: no cover
+            t_yield0 = time.perf_counter()
             item_eval = yield x_gen, True
+            t_suspended += time.perf_counter() - t_yield0
             _, y_gen, c_gen = item_eval
             try:
                 x_gen = opt_gen.send(y_gen)
@@ -871,6 +919,25 @@ def epoch(
     best_x, best_y = res.best_x, res.best_y
     gen_index, x, y = res.gen_index, res.x, res.y
 
+    if telemetry:
+        dt = time.perf_counter() - t_opt0 - t_suspended
+        n_gen = int(gen_index.max()) if len(gen_index) else 0
+        reasons = getattr(termination, "stop_reasons", lambda: [])()
+        telemetry.observe("phase_duration_seconds", dt, phase="optimize")
+        telemetry.event(
+            "phase", phase="optimize", duration_s=dt,
+            n_generations=n_gen,
+            n_evals=int(x.shape[0]),
+            gens_per_sec=round(n_gen / dt, 3) if dt > 0 else None,
+            termination=(
+                "+".join(reasons)
+                if reasons
+                else ("criterion" if termination is not None
+                      else "num_generations")
+            ),
+        )
+        telemetry.inc("ea_generations_total", n_gen)
+
     if mdl.objective is not None:
         # dedupe resample candidates against already-evaluated points
         # (reference MOASMO.py:441-448)
@@ -879,6 +946,13 @@ def epoch(
         best_y = best_y[~is_duplicate]
         D = _as_np(crowding_distance(jnp.asarray(best_y)))
         idxr = D.argsort()[::-1][:N_resample]
+        if telemetry:
+            telemetry.inc("resample_points_total", len(idxr))
+            telemetry.event(
+                "resample",
+                resample_batch=int(len(idxr)),
+                resample_duplicates_removed=int(is_duplicate.sum()),
+            )
         return {
             "x_resample": best_x[idxr, :], "y_pred": best_y[idxr, :],
             "gen_index": gen_index, "x_sm": x, "y_sm": y,

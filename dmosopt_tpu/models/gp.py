@@ -114,6 +114,7 @@ class GPFit(NamedTuple):
     y_std: jax.Array  # (d,)
     nmll: jax.Array  # (d,) final negative log marginal likelihood
     train_mask: jax.Array  # (N,) 1 = real training row, 0 = bucket padding
+    n_steps: Optional[jax.Array] = None  # () int32, Adam steps actually run
 
 
 def _default_rel_jitter(dtype) -> float:
@@ -207,8 +208,11 @@ def _scan_with_convergence(step, carry0, n_iter, convergence_tol,
     (params, opt_state, best_params, best_vals). inf -> finite
     improvements count as improving (delta inf); inf -> inf is nan (not
     improving); the first chunk always runs. `convergence_tol=None`
-    restores the fixed-length scan; `n_iter` stays the hard cap (a
-    non-converged run still owes the remainder steps)."""
+    restores the fixed-length scan; `n_iter` stays the hard cap.
+
+    Returns (carry, n_steps) where n_steps is the () int32 count of
+    optimizer steps actually executed (== n_iter when stopping is
+    disabled or never triggered)."""
     chunk = (
         max(1, min(convergence_check_every, n_iter))
         if convergence_tol is not None
@@ -216,7 +220,7 @@ def _scan_with_convergence(step, carry0, n_iter, convergence_tol,
     )
     if convergence_tol is None or chunk >= n_iter:
         carry, _ = jax.lax.scan(step, carry0, None, length=n_iter)
-        return carry
+        return carry, jnp.asarray(n_iter, jnp.int32)
 
     tol = jnp.asarray(convergence_tol, dt)
     n_full, rem = divmod(n_iter, chunk)
@@ -242,18 +246,27 @@ def _scan_with_convergence(step, carry0, n_iter, convergence_tol,
         cond, body,
         (*carry0, jnp.asarray(0, jnp.int32), jnp.full_like(win0, jnp.inf)),
     )
-    *inner, i_done, _ = carry
+    *inner, i_done, prev_win = carry
     inner = tuple(inner)
+    n_steps = i_done * chunk
     if rem:
-        # only a run that exhausted every chunk without converging
-        # still owes the remainder steps (exact n_iter semantics)
+        # only a run that exhausted every chunk without converging still
+        # owes the remainder steps (exact n_iter semantics). The count
+        # cap exits the while_loop before `cond` re-evaluates the final
+        # chunk, so re-apply its improvement predicate here: a run whose
+        # last full chunk already converged stops exactly there.
+        win = winner_fn(inner[3])
+        delta = prev_win - win
+        improving = jnp.any(delta > tol * jnp.maximum(1.0, jnp.abs(win)))
+        owes_rem = (i_done == n_full) & improving
         inner = jax.lax.cond(
-            i_done == n_full,
+            owes_rem,
             lambda c: jax.lax.scan(step, c, None, length=rem)[0],
             lambda c: c,
             inner,
         )
-    return inner
+        n_steps = n_steps + jnp.where(owes_rem, rem, 0)
+    return inner, n_steps.astype(jnp.int32)
 
 
 @partial(
@@ -389,7 +402,7 @@ def fit_gp_batch(
     # the winner is what the fit returns — the best restart per
     # objective; a losing restart still wandering must not keep the
     # loop alive. tol None disables stopping; 0.0 is a real tolerance.
-    _, _, params, final = _scan_with_convergence(
+    (_, _, params, final), n_steps = _scan_with_convergence(
         step, (params0, opt_state0, params0, inf0), n_iter,
         convergence_tol, convergence_check_every,
         lambda best_vals: jnp.min(best_vals, axis=0), dt,
@@ -418,7 +431,7 @@ def fit_gp_batch(
     tm = jnp.ones((N,), dt) if train_mask is None else train_mask.astype(dt)
     return GPFit(X=X, L=L, alpha=alpha, amp=amp, ls=ls, noise=noise,
                  y_mean=zeros, y_std=jnp.ones((d,), dt), nmll=nmll,
-                 train_mask=tm)
+                 train_mask=tm, n_steps=n_steps)
 
 
 @partial(
@@ -516,7 +529,7 @@ def fit_gp_shared(
         params = optax.apply_updates(params, updates)
         return (params, opt_state, best_params, best_vals), None
 
-    _, _, params, vals = _scan_with_convergence(
+    (_, _, params, vals), n_steps = _scan_with_convergence(
         step,
         (params0, opt.init(params0), params0,
          jnp.full((n_starts,), jnp.inf, dt)),
@@ -545,6 +558,7 @@ def fit_gp_shared(
         train_mask=(
             jnp.ones((N,), dt) if train_mask is None else train_mask.astype(dt)
         ),
+        n_steps=n_steps,
     )
 
 
@@ -576,6 +590,22 @@ def gp_predict(fit: GPFit, Xq: jax.Array, kernel: str = "matern52"):
 
 
 # ---------------------------------------------------------------- wrappers
+
+
+def _gp_fit_info(fit: GPFit, n_iter: int) -> dict:
+    """Host-side summary of one hyperparameter fit: winning per-objective
+    NMLLs, their mean as the scalar `loss`, and the convergence-stop
+    accounting (`n_steps` < `n_iter_max` means the in-graph criterion
+    fired early)."""
+    nmll = np.asarray(fit.nmll, dtype=np.float64)
+    n_steps = int(fit.n_steps) if fit.n_steps is not None else int(n_iter)
+    return {
+        "loss": float(np.mean(nmll)),
+        "nmll_per_objective": [float(v) for v in nmll],
+        "n_steps": n_steps,
+        "n_iter_max": int(n_iter),
+        "early_stopped": n_steps < int(n_iter),
+    }
 
 
 def _prepare_training_data(model, xin, yin, nInput, nOutput, xlb, xub, nan, top_k):
@@ -665,6 +695,11 @@ class SurrogateMixin:
             return mean, var
         return mean
 
+    def get_stats(self):
+        """Fit-result summary (final loss, optimizer steps, early-stop)
+        for epoch stats and the telemetry `train` phase event."""
+        return dict(getattr(self, "fit_info", None) or {})
+
 
 class GPR_Matern(SurrogateMixin):
     """Independent exact GP per objective, Matérn-5/2 kernel.
@@ -744,6 +779,7 @@ class GPR_Matern(SurrogateMixin):
             y_mean=jnp.asarray(y_mean, dt),
             y_std=jnp.asarray(y_std, dt),
         )
+        self.fit_info = _gp_fit_info(fit, n_iter)
 
     # jax-traceable prediction on unit-box-normalized input
     def predict_normalized(self, Xq: jax.Array):
@@ -833,5 +869,6 @@ class MEGP_Matern(SurrogateMixin):
             y_mean=jnp.asarray(y_mean, jnp.float32),
             y_std=jnp.asarray(y_std, jnp.float32),
         )
+        self.fit_info = _gp_fit_info(fit, n_iter)
 
     predict_normalized = GPR_Matern.predict_normalized

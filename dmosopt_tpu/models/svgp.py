@@ -316,6 +316,16 @@ class _SVGPBase(SurrogateMixin):
         self.fit = fit
         self.y_mean = jnp.asarray(y_mean, jnp.float32)
         self.y_std = jnp.asarray(y_std, jnp.float32)
+        # variational fits run the full fixed-length Adam scan; the loss
+        # is the negative final ELBO (same lower-is-better orientation
+        # as the exact-GP NMLL in `gp._gp_fit_info`)
+        self.fit_info = {
+            "loss": -float(fit.elbo),
+            "n_steps": int(n_iter),
+            "n_iter_max": int(n_iter),
+            "early_stopped": False,
+            "n_inducing": int(n_inducing),
+        }
 
     def predict_normalized(self, Xq):
         mean, var = svgp_predict(self.fit, Xq)

@@ -41,6 +41,7 @@ class HostFunEvaluator:
     def __init__(self, eval_fun: Callable, n_workers: int = 1):
         self.eval_fun = eval_fun
         self.n_workers = int(n_workers)
+        self.telemetry = None  # attached by the driver when enabled
         self._pool = (
             ThreadPoolExecutor(max_workers=self.n_workers)
             if self.n_workers > 1
@@ -50,9 +51,20 @@ class HostFunEvaluator:
     def evaluate_batch(
         self, space_vals_list: Sequence[Dict[Any, np.ndarray]]
     ) -> List[Dict]:
+        t0 = time.perf_counter()
         if self._pool is not None:
-            return list(self._pool.map(self.eval_fun, space_vals_list))
-        return [self.eval_fun(sv) for sv in space_vals_list]
+            out = list(self._pool.map(self.eval_fun, space_vals_list))
+        else:
+            out = [self.eval_fun(sv) for sv in space_vals_list]
+        tel = self.telemetry
+        if tel:
+            tel.inc("eval_batches_total", backend="host")
+            tel.observe(
+                "eval_batch_duration_seconds",
+                time.perf_counter() - t0,
+                backend="host",
+            )
+        return out
 
     def close(self):
         if self._pool is not None:
@@ -86,6 +98,8 @@ class JaxBatchEvaluator:
         self.has_features = has_features
         self.has_constraints = has_constraints
         self.mesh = mesh
+        self.telemetry = None  # attached by the driver when enabled
+        self._seen_shapes = set()  # batch shapes already compiled
         if mesh is not None:
             # default to the mesh's leading axis — the population/batch
             # axis by the repo's mesh convention (parallel/mesh.py)
@@ -114,7 +128,20 @@ class JaxBatchEvaluator:
         pad = (-B) % self._n_shards
         if pad:
             X = np.concatenate([X, np.repeat(X[-1:], pad, axis=0)], axis=0)
+        tel = self.telemetry
+        if tel and X.shape not in self._seen_shapes:
+            # a new batch shape forces an XLA retrace+compile; the
+            # counter attributes the dispatch-time spike below to it
+            self._seen_shapes.add(X.shape)
+            tel.inc("eval_batch_compiles_total")
+        t0 = time.perf_counter()
         out = self._fn(jnp.asarray(X, jnp.float32))
+        if tel:
+            t1 = time.perf_counter()  # async dispatch returned
+            jax.block_until_ready(out)
+            t2 = time.perf_counter()  # device execution drained
+            tel.observe("eval_dispatch_seconds", t1 - t0)
+            tel.observe("eval_execute_seconds", t2 - t1)
         if not isinstance(out, tuple):
             out = (out,)
         return tuple(self._to_host(o)[:B] for o in out)
@@ -139,6 +166,12 @@ class JaxBatchEvaluator:
         dt = (time.time() - t0) / max(len(space_vals_list), 1)
         for r in results:
             r["time"] = dt
+        tel = self.telemetry
+        if tel:
+            tel.inc("eval_batches_total", backend="jax")
+            tel.observe(
+                "eval_batch_duration_seconds", time.time() - t0, backend="jax"
+            )
         return results
 
     def close(self):

@@ -28,6 +28,7 @@ Layout:
     /{opt_id}/{problem_id}/surrogate_evals/{epoch}/{gen_index,x,y}
     /{opt_id}/{problem_id}/optimizer_params/{epoch}  (json attrs)
     /{opt_id}/{problem_id}/optimizer_stats/{epoch}   (json attrs)
+    /{opt_id}/telemetry                              (one json attr per epoch)
 """
 
 from __future__ import annotations
@@ -374,6 +375,30 @@ def save_optimizer_params_to_h5(
                 )
             except TypeError:
                 grp.attrs[k] = str(v)
+
+
+def save_telemetry_to_h5(opt_id, epoch, summary, fpath, logger=None):
+    """Append one epoch's telemetry summary (the JSON-able dict built by
+    `Telemetry.epoch_summary`) under `/{opt_id}/telemetry`, keyed by the
+    epoch label. One JSON attribute per epoch: append-friendly,
+    overwrite-safe when a resumed run re-lands on an epoch number, and
+    readable with any HDF5 tool."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        grp = h5_get_group(h5, f"{opt_id}/telemetry")
+        _json_attr(grp, str(int(epoch)), summary)
+
+
+def load_telemetry_from_h5(fpath, opt_id) -> Dict[int, Dict]:
+    """All stored epoch telemetry summaries, `{epoch: summary}` (empty
+    dict when the run predates the telemetry group or had it disabled)."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "r") as h5:
+        key = f"{opt_id}/telemetry"
+        if key not in h5:
+            return {}
+        grp = h5[key]
+        return {int(k): json.loads(grp.attrs[k]) for k in grp.attrs}
 
 
 def save_stats_to_h5(opt_id, problem_id, epoch, fpath, logger=None, stats=None):

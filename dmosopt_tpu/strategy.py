@@ -32,6 +32,7 @@ from dmosopt_tpu.datatypes import (
 )
 from dmosopt_tpu.moasmo import get_duplicates
 from dmosopt_tpu.ops import order_mo
+from dmosopt_tpu.telemetry import phase_scope
 
 import jax.numpy as jnp
 
@@ -79,7 +80,8 @@ class DistOptStrategy:
         optimize_mean_variance: bool = False,
         # runtime plumbing
         local_random=None, logger=None, file_path=None, mesh=None,
-        persist_features: bool = False,
+        persist_features: bool = False, telemetry=None,
+        xinit_epoch: int = 0,
     ):
         self.__dict__.update(
             prob=prob,
@@ -87,6 +89,7 @@ class DistOptStrategy:
             logger=logger,
             file_path=file_path,
             mesh=mesh,
+            telemetry=telemetry,
             feasibility_method_name=feasibility_method_name,
             surrogate_method_name=surrogate_method_name,
             surrogate_custom_training=surrogate_custom_training,
@@ -121,12 +124,20 @@ class DistOptStrategy:
         # seed the request queue with the initial design; on resume, points
         # already in the restored archive are filtered out lazily
         n_previous = None if self.x is None else self.x.shape[0]
-        xinit = opt.xinit(
-            n_initial, prob.param_names, prob.lb, prob.ub,
-            method=initial_method, maxiter=initial_maxiter,
-            nPrevious=n_previous, local_random=self.local_random,
-            logger=self.logger,
-        )
+        # the archive labels the initial design epoch 0 by the
+        # request-queue convention (EvalRequest(..., epoch=0) below),
+        # but the telemetry event is tagged with the run's first epoch
+        # (`xinit_epoch`, > 0 on resume) — epoch-0 events would be
+        # pruned by set_epoch(start_epoch) before any summary saw them
+        with phase_scope(self.telemetry, "xinit", epoch=xinit_epoch) as ph:
+            xinit = opt.xinit(
+                n_initial, prob.param_names, prob.lb, prob.ub,
+                method=initial_method, maxiter=initial_maxiter,
+                nPrevious=n_previous, local_random=self.local_random,
+                logger=self.logger,
+            )
+            if xinit is not None:
+                ph["n_points"] = int(xinit.shape[0])
         self.reqs = []
         if xinit is not None:
             if xinit.shape[1] != prob.dim:
@@ -338,7 +349,7 @@ class DistOptStrategy:
             "sensitivity_method_name", "sensitivity_method_kwargs",
             "feasibility_method_name", "feasibility_method_kwargs",
             "optimize_mean_variance", "termination", "local_random",
-            "logger", "file_path", "mesh",
+            "logger", "file_path", "mesh", "telemetry",
         )
         spec = {name: getattr(self, name) for name in plumbed}
         spec.update(

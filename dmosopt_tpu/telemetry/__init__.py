@@ -1,0 +1,287 @@
+"""End-to-end telemetry: metrics registry + event log + device tracing.
+
+One `Telemetry` object travels the whole stack — driver epoch loop,
+per-problem strategies, the MO-ASMO phases, the evaluation backends and
+the compile cache — so a run's observability has a single switchboard:
+
+- `Telemetry.registry` (`MetricsRegistry`): counters/gauges/histograms.
+- `Telemetry.log` (`EventLog`): typed per-epoch/per-phase records with a
+  bounded ring buffer and an optional JSONL sink.
+- `jax.profiler` device traces for selected epochs
+  (``profile_dir`` / ``profile_epochs``, captured by the driver via
+  `dmosopt_tpu.utils.profiling.device_trace`).
+
+Configuration arrives through the driver's ``telemetry`` parameter
+(``dopt_params["telemetry"]``): ``True``/``None`` for the on-by-default
+instance, ``False`` to disable (the driver then holds no telemetry
+object at all — zero calls on the hot path), a dict of `Telemetry`
+constructor kwargs, or a ready-made `Telemetry` instance. The metric
+name catalog lives in ``docs/observability.md`` and is enforced by
+``make lint-metrics``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Optional, Sequence, Union
+
+from dmosopt_tpu.telemetry.events import Event, EventLog, jsonable, read_jsonl  # noqa: F401
+from dmosopt_tpu.telemetry.registry import MetricsRegistry  # noqa: F401
+
+# Telemetry summaries merge these aggregates across a run's eval events
+# (the rest of `eval_time_stats` — std/median — does not merge exactly).
+_EVAL_MERGE_KEYS = ("eval_min", "eval_max", "eval_sum")
+
+
+class Telemetry:
+    """Facade over the registry + event log with phase-timer helpers.
+
+    A disabled instance (``enabled=False``) is a true no-op: every
+    mutator returns immediately without touching the registry or the
+    log, and ``bool(tel)`` is False so call sites can skip whole
+    instrumentation blocks. The framework goes one step further for
+    ``telemetry=False`` runs: the driver holds ``None`` instead, so the
+    hot path performs zero telemetry calls of any kind.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        ring_size: int = 1024,
+        jsonl_path: Optional[str] = None,
+        profile_dir: Optional[str] = None,
+        profile_epochs: Optional[Sequence[int]] = None,
+        histogram_buckets: Optional[Dict[str, Sequence[float]]] = None,
+    ):
+        self.enabled = bool(enabled)
+        self.registry = MetricsRegistry(histogram_buckets=histogram_buckets)
+        self.log = EventLog(
+            ring_size=ring_size,
+            jsonl_path=jsonl_path if self.enabled else None,
+        )
+        self.profile_dir = profile_dir
+        self.profile_epochs = (
+            frozenset(int(e) for e in profile_epochs)
+            if profile_epochs is not None
+            else None
+        )
+        self.epoch: Optional[int] = None  # default epoch stamp for events
+        # complete per-epoch event index for `epoch_summary`: the ring
+        # buffer is bounded, so an event-heavy epoch (one eval drain per
+        # generation in evaluation mode) could evict its own early
+        # events before the driver persists the summary. Entries for
+        # epochs older than the current one are pruned by `set_epoch`
+        # (the driver persists each epoch before advancing).
+        self._events_by_epoch: Dict[int, list] = {}
+
+    def __bool__(self) -> bool:
+        return self.enabled
+
+    # -------------------------------------------------------------- state
+
+    def set_epoch(self, epoch: Optional[int]):
+        self.epoch = int(epoch) if epoch is not None else None
+        if self.epoch is not None:
+            for e in [e for e in self._events_by_epoch if e < self.epoch]:
+                del self._events_by_epoch[e]
+
+    def should_trace(self, epoch: int) -> bool:
+        """Capture a device trace for this epoch? Requires a
+        ``profile_dir``; ``profile_epochs=None`` traces every epoch,
+        otherwise only the listed ones."""
+        if not self.enabled or self.profile_dir is None:
+            return False
+        return self.profile_epochs is None or int(epoch) in self.profile_epochs
+
+    # ------------------------------------------------------------ metrics
+
+    def inc(self, name: str, value: float = 1.0, **labels):
+        if self.enabled:
+            self.registry.counter_inc(name, value, **labels)
+
+    def gauge(self, name: str, value: float, **labels):
+        if self.enabled:
+            self.registry.gauge_set(name, value, **labels)
+
+    def observe(self, name: str, value: float, **labels):
+        if self.enabled:
+            self.registry.histogram_observe(name, value, **labels)
+
+    # ------------------------------------------------------------- events
+
+    def event(self, kind: str, epoch: Optional[int] = None, **fields) -> Optional[Event]:
+        if not self.enabled:
+            return None
+        ev = self.log.emit(
+            kind, epoch=epoch if epoch is not None else self.epoch, **fields
+        )
+        if ev.epoch is not None:
+            self._events_by_epoch.setdefault(ev.epoch, []).append(ev)
+        return ev
+
+    @contextlib.contextmanager
+    def phase(self, phase: str, epoch: Optional[int] = None, **fields):
+        """Time a region: on exit, observes `phase_duration_seconds`
+        {phase=...} and emits one ``phase`` event. Yields a mutable dict
+        the caller can extend with result fields (n_train, gens_per_sec,
+        ...) before the event is written."""
+        if not self.enabled:
+            yield {}
+            return
+        extra: Dict[str, Any] = dict(fields)
+        t0 = time.perf_counter()
+        try:
+            yield extra
+        finally:
+            dt = time.perf_counter() - t0
+            self.observe("phase_duration_seconds", dt, phase=phase)
+            self.event("phase", epoch=epoch, phase=phase, duration_s=dt, **extra)
+
+    # ------------------------------------------------------------ summary
+
+    def epoch_summary(self, epoch: int) -> Dict[str, Any]:
+        """One epoch's events folded into a flat JSON-able summary dict:
+        per-phase durations, EA throughput, surrogate-fit results, merged
+        eval-time aggregates, resample accounting. This is what the
+        driver persists into the HDF5 ``telemetry`` group and what the
+        ``telemetry`` CLI renders. Reads the complete per-epoch event
+        index when the epoch is still held there (current epoch and
+        newer), falling back to the ring buffer for pruned epochs."""
+        summary: Dict[str, Any] = {"epoch": int(epoch), "phases": {}}
+        eval_agg = {"eval_n": 0, "eval_sum": 0.0, "eval_min": None, "eval_max": None}
+        # a multi-problem epoch emits one train/optimize/resample event
+        # per problem: summable counters accumulate, ratio fields
+        # average, termination reasons union, and gens_per_sec is
+        # recomputed from the totals below — last-writer-wins would pair
+        # one problem's throughput with the summed durations
+        mean_acc: Dict[str, list] = {}
+        terminations: list = []
+        events = self._events_by_epoch.get(int(epoch))
+        if events is None:
+            events = self.log.records(epoch=int(epoch))
+        for ev in events:
+            f = ev.fields
+            if ev.kind == "phase":
+                name = f.get("phase", "unknown")
+                summary["phases"][name] = (
+                    summary["phases"].get(name, 0.0) + float(f.get("duration_s", 0.0))
+                )
+                if name == "train":
+                    for k in ("n_train", "duplicates_removed", "fit_n_steps"):
+                        if k in f:
+                            summary[k] = summary.get(k, 0) + f[k]
+                    for k in ("feasible_fraction", "surrogate_loss"):
+                        if f.get(k) is not None:
+                            mean_acc.setdefault(k, []).append(float(f[k]))
+                    if "surrogate" in f:
+                        summary["surrogate"] = f["surrogate"]
+                    if "fit_early_stopped" in f:
+                        summary["fit_early_stopped"] = bool(
+                            summary.get("fit_early_stopped", False)
+                            or f["fit_early_stopped"]
+                        )
+                elif name == "optimize":
+                    for k in ("n_generations", "n_evals"):
+                        if k in f:
+                            summary[k] = summary.get(k, 0) + f[k]
+                    t = f.get("termination")
+                    if t is not None and t not in terminations:
+                        terminations.append(t)
+                elif name == "xinit" and "n_points" in f:
+                    summary["n_initial_points"] = f["n_points"]
+                elif name == "eval":
+                    n = int(f.get("n_evals", 0))
+                    eval_agg["eval_n"] += n
+                    if f.get("eval_sum", -1.0) and f.get("eval_sum", -1.0) > 0:
+                        eval_agg["eval_sum"] += float(f["eval_sum"])
+                    for k, red in (("eval_min", min), ("eval_max", max)):
+                        v = f.get(k)
+                        if v is not None and v > 0:
+                            eval_agg[k] = (
+                                v if eval_agg[k] is None else red(eval_agg[k], v)
+                            )
+            elif ev.kind == "epoch":
+                summary["wall_s"] = f.get("duration_s")
+                for k in ("eval_count", "save_count"):
+                    if k in f:
+                        summary[k] = f[k]
+            elif ev.kind == "resample":
+                for k in ("resample_batch", "resample_duplicates_removed"):
+                    if k in f:
+                        summary[k] = summary.get(k, 0) + f[k]
+        for k, vals in mean_acc.items():
+            summary[k] = sum(vals) / len(vals)
+        if terminations:
+            summary["termination"] = "+".join(terminations)
+        opt_s = summary["phases"].get("optimize")
+        if opt_s and summary.get("n_generations"):
+            summary["gens_per_sec"] = round(summary["n_generations"] / opt_s, 3)
+        if eval_agg["eval_n"]:
+            eval_agg["eval_mean"] = (
+                eval_agg["eval_sum"] / eval_agg["eval_n"]
+                if eval_agg["eval_sum"]
+                else None
+            )
+            summary["eval"] = eval_agg
+        return jsonable(summary)
+
+    def close(self):
+        self.log.close()
+
+
+def phase_scope(tel: Optional["Telemetry"], phase: str, epoch=None, **fields):
+    """`tel.phase(...)` when telemetry is live, else a no-op context
+    yielding a throwaway dict — instrumented call sites stay one-liners
+    and a disabled run performs zero telemetry calls."""
+    if tel:
+        return tel.phase(phase, epoch=epoch, **fields)
+    return contextlib.nullcontext({})
+
+
+def record_device_memory(tel: Optional["Telemetry"]):
+    """Gauge per-device memory from `jax.local_devices()` where the
+    backend reports it (TPU/GPU; CPU devices return None — no-op)."""
+    if not tel:
+        return
+    try:
+        import jax
+
+        for dev in jax.local_devices():
+            stats = dev.memory_stats()
+            if not stats:
+                continue
+            for src, name in (
+                ("bytes_in_use", "device_memory_bytes_in_use"),
+                ("peak_bytes_in_use", "device_memory_peak_bytes"),
+                ("bytes_limit", "device_memory_bytes_limit"),
+            ):
+                if src in stats:
+                    tel.gauge(name, float(stats[src]), device=str(dev.id))
+    except Exception:  # memory stats are best-effort on every backend
+        pass
+
+
+def create_telemetry(
+    spec: Union[None, bool, Dict, Telemetry] = None,
+) -> Optional[Telemetry]:
+    """Resolve the driver's ``telemetry`` config value.
+
+    ``None``/``True`` -> a default enabled `Telemetry`; ``False`` (or a
+    dict with ``enabled: False``) -> ``None`` — the caller holds no
+    telemetry object and its hot path makes zero telemetry calls; a
+    dict -> `Telemetry(**dict)`; an existing instance passes through.
+    """
+    if spec is None or spec is True:
+        return Telemetry()
+    if spec is False:
+        return None
+    if isinstance(spec, Telemetry):
+        return spec if spec.enabled else None
+    if isinstance(spec, dict):
+        if not spec.get("enabled", True):
+            return None
+        return Telemetry(**spec)
+    raise TypeError(
+        f"telemetry must be None, bool, dict, or Telemetry; got {type(spec)!r}"
+    )

@@ -1,0 +1,131 @@
+"""Structured event log: typed per-epoch/per-phase records.
+
+Events are the narrative complement to the metrics registry: where a
+counter says "eval batches: 12", the event log says *which epoch's eval
+phase took how long with what per-eval statistics*. Each record is an
+`Event` (kind, timestamp, optional epoch, free-form fields) held in a
+bounded in-memory ring buffer and, when a ``jsonl_path`` is configured,
+appended to a JSON-lines file — one self-describing JSON object per
+line, so a run's telemetry can be tailed, grepped, or loaded with any
+JSON tooling while the run is still going.
+
+Known kinds (free-form kinds are allowed; these are what the framework
+emits and what ``Telemetry.epoch_summary`` understands):
+
+- ``phase``   — one timed region of an epoch; fields always include
+  ``phase`` (xinit | train | optimize | eval) and ``duration_s``.
+- ``epoch``   — one driver epoch completed; ``duration_s``, counters.
+- ``resample``— resample selection of an epoch; batch size, dedupe.
+- ``compile_cache`` — persistent-cache accounting at run end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+
+def jsonable(value):
+    """Coerce numpy scalars/arrays and other common non-JSON types to
+    plain Python so every event (and the HDF5 summary built from them)
+    serializes without a custom encoder."""
+    import numpy as np
+
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    if isinstance(value, (np.bool_,)):
+        return bool(value)
+    if isinstance(value, np.ndarray):
+        return [jsonable(v) for v in value.tolist()]
+    if isinstance(value, dict):
+        return {str(k): jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [jsonable(v) for v in value]
+    return value
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    kind: str
+    ts: float
+    epoch: Optional[int]
+    fields: Dict[str, Any]
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"kind": self.kind, "ts": self.ts}
+        if self.epoch is not None:
+            out["epoch"] = self.epoch
+        out.update(self.fields)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Event":
+        d = dict(d)
+        kind = d.pop("kind")
+        ts = d.pop("ts")
+        epoch = d.pop("epoch", None)
+        return cls(kind=kind, ts=ts, epoch=epoch, fields=d)
+
+
+class EventLog:
+    """Bounded ring buffer of `Event`s with an optional JSONL sink."""
+
+    def __init__(self, ring_size: int = 1024, jsonl_path: Optional[str] = None):
+        if ring_size <= 0:
+            raise ValueError("ring_size must be positive")
+        self._ring: deque = deque(maxlen=int(ring_size))
+        self.jsonl_path = jsonl_path
+        self._lock = threading.Lock()
+        self._fh = None
+        if jsonl_path is not None:
+            self._fh = open(jsonl_path, "a", buffering=1)  # line-buffered
+
+    def emit(self, kind: str, epoch: Optional[int] = None, **fields) -> Event:
+        if not isinstance(kind, str) or not kind:
+            raise ValueError(f"event kind must be a non-empty string: {kind!r}")
+        ev = Event(
+            kind=kind,
+            ts=time.time(),
+            epoch=int(epoch) if epoch is not None else None,
+            fields={k: jsonable(v) for k, v in fields.items()},
+        )
+        with self._lock:
+            self._ring.append(ev)
+            if self._fh is not None:
+                self._fh.write(json.dumps(ev.to_dict()) + "\n")
+        return ev
+
+    def records(
+        self, kind: Optional[str] = None, epoch: Optional[int] = None
+    ) -> List[Event]:
+        with self._lock:
+            evs = list(self._ring)
+        if kind is not None:
+            evs = [e for e in evs if e.kind == kind]
+        if epoch is not None:
+            evs = [e for e in evs if e.epoch == epoch]
+        return evs
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def read_jsonl(path: str) -> Iterator[Event]:
+    """Load events back from a JSONL sink (round-trip of `EventLog.emit`)."""
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                yield Event.from_dict(json.loads(line))

@@ -1,0 +1,148 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+The registry is deliberately tiny and dependency-free — a dict of
+counters (monotonic floats), gauges (last value wins) and histograms
+(fixed bucket boundaries, plus running min/max/sum/count), each keyed by
+``(name, sorted label items)``. It is the in-process aggregation layer
+under the telemetry facade: every emission is one dict update, cheap
+enough to stay on by default, and `snapshot()` renders the whole state
+as plain JSON-able types for logs, tests, and the HDF5 epoch summary.
+
+Metric names are lowercase snake_case and must appear in the catalog in
+``docs/observability.md`` (enforced by ``tools/lint_metrics.py`` /
+``make lint-metrics``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+# Log-spaced seconds-oriented default buckets: phase durations span
+# ~1 ms (a cached surrogate predict) to minutes (a cold-compile epoch).
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, math.inf,
+)
+
+
+def _label_key(labels: Dict) -> Tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: Tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in key)
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "sum", "count", "min", "max")
+
+    def __init__(self, buckets: Sequence[float]):
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs or bs[-1] != math.inf:
+            bs = bs + (math.inf,)
+        self.buckets = bs
+        self.counts = [0] * len(bs)
+        self.sum = 0.0
+        self.count = 0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float):
+        v = float(value)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.sum += v
+        self.count += 1
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def summary(self) -> Dict:
+        out = {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            "mean": (self.sum / self.count) if self.count else None,
+            "buckets": {
+                ("inf" if math.isinf(b) else repr(b)): c
+                for b, c in zip(self.buckets, self.counts)
+                if c
+            },
+        }
+        return out
+
+
+class MetricsRegistry:
+    """Counters / gauges / histograms with labels.
+
+    All mutators take the metric name, a value, and free-form keyword
+    labels; each distinct label combination is an independent series.
+    Thread-safe: the driver's evaluator thread pool may emit from
+    worker threads.
+    """
+
+    def __init__(self, histogram_buckets: Optional[Dict[str, Sequence[float]]] = None):
+        self._counters: Dict[Tuple, float] = {}
+        self._gauges: Dict[Tuple, float] = {}
+        self._histograms: Dict[Tuple, _Histogram] = {}
+        self._buckets_by_name = dict(histogram_buckets or {})
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ mutators
+
+    def counter_inc(self, name: str, value: float = 1.0, **labels):
+        if value < 0:
+            raise ValueError(f"counter {name!r}: negative increment {value}")
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + float(value)
+
+    def gauge_set(self, name: str, value: float, **labels):
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = float(value)
+
+    def histogram_observe(self, name: str, value: float, **labels):
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = _Histogram(
+                    self._buckets_by_name.get(name, DEFAULT_BUCKETS)
+                )
+            h.observe(value)
+
+    # ------------------------------------------------------------- queries
+
+    def counter_value(self, name: str, **labels) -> float:
+        return self._counters.get((name, _label_key(labels)), 0.0)
+
+    def gauge_value(self, name: str, **labels) -> Optional[float]:
+        return self._gauges.get((name, _label_key(labels)))
+
+    def histogram_summary(self, name: str, **labels) -> Optional[Dict]:
+        h = self._histograms.get((name, _label_key(labels)))
+        return h.summary() if h is not None else None
+
+    def metric_names(self) -> set:
+        with self._lock:
+            return {
+                name
+                for store in (self._counters, self._gauges, self._histograms)
+                for (name, _) in store
+            }
+
+    def snapshot(self) -> Dict:
+        """The whole registry as nested plain dicts:
+        ``{"counters": {name: {label_str: value}}, "gauges": {...},
+        "histograms": {name: {label_str: summary}}}``."""
+        with self._lock:
+            out = {"counters": {}, "gauges": {}, "histograms": {}}
+            for (name, key), v in self._counters.items():
+                out["counters"].setdefault(name, {})[_label_str(key)] = v
+            for (name, key), v in self._gauges.items():
+                out["gauges"].setdefault(name, {})[_label_str(key)] = v
+            for (name, key), h in self._histograms.items():
+                out["histograms"].setdefault(name, {})[_label_str(key)] = h.summary()
+            return out

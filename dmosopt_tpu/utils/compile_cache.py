@@ -10,6 +10,48 @@ hosts starts a fresh cache instead of loading a mismatched one.
 import hashlib
 import os
 
+# Persistent-cache accounting, fed by jax.monitoring events. Misses are
+# DERIVED as requests - hits: jax's own '.../cache_misses' event only
+# fires when an entry is actually written, so it skips compiles below
+# the min-compile-time/entry-size persistence gates — every cache-aware
+# compile emits '.../compile_requests_use_cache', and every non-hit
+# request is a miss. Module-level so the counts accumulate from the
+# moment the cache is enabled — before any Telemetry object exists —
+# and the driver reads them at run end.
+_CACHE_STATS = {"hits": 0, "requests": 0}
+_listener_registered = False
+
+
+def _on_monitoring_event(event: str, **kwargs):
+    if "compilation_cache" not in event:
+        return
+    if event.endswith("cache_hits"):
+        _CACHE_STATS["hits"] += 1
+    elif event.endswith("compile_requests_use_cache"):
+        _CACHE_STATS["requests"] += 1
+
+
+def cache_stats() -> dict:
+    """Hit/miss/request counts of the persistent compilation cache for
+    this process (all zero when `enable_persistent_cache` was never
+    called)."""
+    hits, requests = _CACHE_STATS["hits"], _CACHE_STATS["requests"]
+    return {"hits": hits, "misses": max(0, requests - hits),
+            "requests": requests}
+
+
+def _register_listener():
+    global _listener_registered
+    if _listener_registered:
+        return
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_listener(_on_monitoring_event)
+        _listener_registered = True
+    except Exception:  # monitoring API is version-dependent; stats stay 0
+        pass
+
 
 def _machine_fingerprint() -> str:
     """Stable id for the execution host's ISA surface."""
@@ -33,6 +75,7 @@ def enable_persistent_cache(base_dir: str) -> str:
 
     path = os.path.join(base_dir, _machine_fingerprint())
     os.makedirs(path, exist_ok=True)
+    _register_listener()
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

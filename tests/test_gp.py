@@ -217,8 +217,11 @@ def test_large_n_reroute_filters_gpr_kwargs():
 def test_scan_with_convergence_semantics():
     """The shared in-graph convergence harness (_scan_with_convergence):
     early exit when the winner stops improving, exact n_iter semantics
-    when it never converges (remainder steps included), and tol=None
-    reproducing the fixed-length scan bit for bit."""
+    when it never converges (remainder steps included), the remainder
+    skipped when the final full chunk already converged, and tol=None
+    reproducing the fixed-length scan bit for bit. Total step counts are
+    pinned via both the iteration-counter carry and the returned
+    n_steps."""
     import jax
     import jax.numpy as jnp
 
@@ -240,27 +243,35 @@ def test_scan_with_convergence_semantics():
     # steadily improving: never converges -> runs all n_iter steps,
     # including the remainder chunk (27 = 2 full chunks of 10 + 7)
     step = make_step(lambda p: 1.0)
-    p, _, _, vals = _scan_with_convergence(
+    (p, _, _, vals), n_steps = _scan_with_convergence(
         step, (z, z, z, v0), 27, 1e-3, 10, jnp.min, jnp.float32
     )
-    assert float(p) == 27.0
+    assert float(p) == 27.0 and int(n_steps) == 27
     np.testing.assert_allclose(np.asarray(vals), 10.0 - 27.0)
 
     # improvement collapses after step 10 -> stops after chunk 2 (the
     # chunk that observed no winner movement), far short of n_iter=1000
     step = make_step(lambda p: jnp.where(p <= 10.0, 1.0, 0.0))
-    p, _, _, _ = _scan_with_convergence(
+    (p, _, _, _), n_steps = _scan_with_convergence(
         step, (z, z, z, v0), 1000, 1e-3, 10, jnp.min, jnp.float32
     )
-    assert float(p) == 20.0
+    assert float(p) == 20.0 and int(n_steps) == 20
+
+    # same collapse with a remainder in play (27 = 2 chunks + 7): the
+    # final full chunk observed no improvement, so the remainder steps
+    # are NOT owed — previously the `i_done == n_full` predicate alone
+    # paid them unconditionally
+    (p, _, _, _), n_steps = _scan_with_convergence(
+        step, (z, z, z, v0), 27, 1e-3, 10, jnp.min, jnp.float32
+    )
+    assert float(p) == 20.0 and int(n_steps) == 20
 
     # tol=None: fixed-length scan, identical to lax.scan
-    step = make_step(lambda p: jnp.where(p <= 10.0, 1.0, 0.0))
-    p_none, _, _, vals_none = _scan_with_convergence(
+    (p_none, _, _, vals_none), n_steps = _scan_with_convergence(
         step, (z, z, z, v0), 50, None, 10, jnp.min, jnp.float32
     )
     ref, _ = jax.lax.scan(step, (z, z, z, v0), None, length=50)
-    assert float(p_none) == 50.0
+    assert float(p_none) == 50.0 and int(n_steps) == 50
     np.testing.assert_array_equal(np.asarray(vals_none), np.asarray(ref[3]))
 
 

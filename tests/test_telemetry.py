@@ -1,0 +1,455 @@
+"""Telemetry layer tests: registry semantics, event log round-trip,
+the disabled path's zero-call guarantee, HDF5 persistence across a
+save/restore cycle, and the `telemetry` CLI (docs/observability.md)."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import dmosopt_tpu
+from dmosopt_tpu.telemetry import (
+    EventLog,
+    MetricsRegistry,
+    Telemetry,
+    create_telemetry,
+    phase_scope,
+    read_jsonl,
+)
+
+h5py = pytest.importorskip("h5py")
+
+N_DIM = 5
+
+
+def zdt1_obj(pp):
+    x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+    f1 = x[0]
+    g = 1.0 + 9.0 / (N_DIM - 1) * np.sum(x[1:])
+    f2 = g * (1.0 - np.sqrt(f1 / g))
+    return np.array([f1, f2])
+
+
+def _run_params(file_path, **over):
+    params = {
+        "opt_id": "tel_run",
+        "obj_fun": zdt1_obj,
+        "objective_names": ["f1", "f2"],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(N_DIM)},
+        "problem_parameters": {},
+        "n_initial": 6,
+        "n_epochs": 2,
+        "population_size": 24,
+        "num_generations": 8,
+        "resample_fraction": 0.5,
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 20, "seed": 0},
+        "random_seed": 11,
+        "save": True,
+        "file_path": str(file_path),
+    }
+    params.update(over)
+    return params
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_counter_labels_are_independent_series():
+    reg = MetricsRegistry()
+    reg.counter_inc("evals_total", 2, backend="host")
+    reg.counter_inc("evals_total", 3, backend="host")
+    reg.counter_inc("evals_total", 7, backend="jax")
+    assert reg.counter_value("evals_total", backend="host") == 5
+    assert reg.counter_value("evals_total", backend="jax") == 7
+    # unlabeled is its own series, zero-valued until touched
+    assert reg.counter_value("evals_total") == 0.0
+    assert reg.metric_names() == {"evals_total"}
+    with pytest.raises(ValueError):
+        reg.counter_inc("evals_total", -1)
+
+
+def test_registry_gauge_last_value_wins():
+    reg = MetricsRegistry()
+    reg.gauge_set("device_memory_bytes_in_use", 100.0, device="0")
+    reg.gauge_set("device_memory_bytes_in_use", 250.0, device="0")
+    assert reg.gauge_value("device_memory_bytes_in_use", device="0") == 250.0
+    assert reg.gauge_value("device_memory_bytes_in_use", device="1") is None
+
+
+def test_registry_histogram_buckets():
+    reg = MetricsRegistry(
+        histogram_buckets={"phase_duration_seconds": (0.1, 1.0, 10.0)}
+    )
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        reg.histogram_observe("phase_duration_seconds", v, phase="train")
+    s = reg.histogram_summary("phase_duration_seconds", phase="train")
+    assert s["count"] == 5
+    assert s["min"] == 0.05 and s["max"] == 50.0
+    assert s["sum"] == pytest.approx(56.05)
+    assert s["mean"] == pytest.approx(56.05 / 5)
+    # custom boundaries: one below 0.1, two in (0.1, 1.0], one in
+    # (1.0, 10.0], one in the +inf overflow bucket
+    assert s["buckets"] == {"0.1": 1, "1.0": 2, "10.0": 1, "inf": 1}
+    # a name without custom buckets falls back to the defaults
+    reg.histogram_observe("other_duration", 0.3)
+    assert reg.histogram_summary("other_duration")["count"] == 1
+
+
+def test_registry_snapshot_is_jsonable():
+    reg = MetricsRegistry()
+    reg.counter_inc("epochs_total")
+    reg.gauge_set("compile_cache_hits", 3)
+    reg.histogram_observe("phase_duration_seconds", 0.2, phase="eval")
+    snap = reg.snapshot()
+    json.dumps(snap)  # must serialize without a custom encoder
+    assert snap["counters"]["epochs_total"][""] == 1.0
+    assert snap["histograms"]["phase_duration_seconds"]["phase=eval"]["count"] == 1
+
+
+# ------------------------------------------------------ disabled = no-op
+
+
+def test_disabled_telemetry_is_true_noop():
+    tel = Telemetry(enabled=False)
+    assert not tel
+    tel.inc("evals_total", 5)
+    tel.gauge("compile_cache_hits", 1.0)
+    tel.observe("phase_duration_seconds", 0.1, phase="train")
+    assert tel.event("epoch", duration_s=1.0) is None
+    with tel.phase("train") as ph:
+        ph["n_train"] = 10  # the throwaway dict is still writable
+    assert tel.registry.metric_names() == set()
+    assert len(tel.log) == 0
+
+
+def test_create_telemetry_spec_resolution(tmp_path):
+    assert create_telemetry(None).enabled
+    assert create_telemetry(True).enabled
+    assert create_telemetry(False) is None
+    assert create_telemetry({"enabled": False}) is None
+    tel = create_telemetry({"ring_size": 8, "profile_epochs": [1, 3]})
+    assert tel.log._ring.maxlen == 8
+    assert tel.profile_epochs == frozenset({1, 3})
+    assert create_telemetry(tel) is tel
+    assert create_telemetry(Telemetry(enabled=False)) is None
+    with pytest.raises(TypeError):
+        create_telemetry("yes")
+
+
+def test_phase_scope_none_is_nullcontext():
+    with phase_scope(None, "train") as ph:
+        ph["x"] = 1  # throwaway dict; no telemetry object touched
+
+
+def test_should_trace_gating(tmp_path):
+    assert not Telemetry().should_trace(0)  # no profile_dir
+    tel = Telemetry(profile_dir=str(tmp_path), profile_epochs=[2])
+    assert tel.should_trace(2) and not tel.should_trace(1)
+    # profile_epochs=None traces every epoch once a dir is set
+    assert Telemetry(profile_dir=str(tmp_path)).should_trace(7)
+
+
+# ------------------------------------------------------------- event log
+
+
+def test_event_log_ring_is_bounded():
+    log = EventLog(ring_size=4)
+    for i in range(10):
+        log.emit("phase", epoch=i, phase="train")
+    assert len(log) == 4
+    assert [e.epoch for e in log.records()] == [6, 7, 8, 9]
+    assert [e.epoch for e in log.records(epoch=8)] == [8]
+
+
+def test_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(ring_size=16, jsonl_path=path)
+    log.emit(
+        "phase", epoch=np.int64(0), phase="train",
+        duration_s=np.float32(1.5), n_train=np.int32(40),
+        losses=np.array([0.5, 0.25]),
+    )
+    log.emit("epoch", epoch=1, duration_s=2.0)
+    log.close()
+
+    back = list(read_jsonl(path))
+    assert [(e.kind, e.epoch) for e in back] == [("phase", 0), ("epoch", 1)]
+    f = back[0].fields
+    # numpy payloads landed as plain JSON types
+    assert f["phase"] == "train" and f["n_train"] == 40
+    assert f["duration_s"] == pytest.approx(1.5)
+    assert f["losses"] == [0.5, 0.25]
+    # the file is valid JSONL for external tooling
+    lines = [json.loads(l) for l in open(path)]
+    assert all("ts" in d and "kind" in d for d in lines)
+
+
+def test_epoch_summary_folds_phase_and_eval_events():
+    tel = Telemetry()
+    tel.set_epoch(0)
+    with tel.phase("train") as ph:
+        ph.update(n_train=32, duplicates_removed=2, surrogate="gpr")
+    with tel.phase("optimize") as ph:
+        ph.update(n_generations=10, gens_per_sec=5.0, termination="hvkn")
+    # two eval drains in one epoch merge min/max/sum
+    tel.event("phase", phase="eval", duration_s=0.2, n_evals=4,
+              eval_min=0.01, eval_max=0.05, eval_sum=0.1)
+    tel.event("phase", phase="eval", duration_s=0.3, n_evals=6,
+              eval_min=0.005, eval_max=0.08, eval_sum=0.2)
+    tel.event("epoch", duration_s=1.25, eval_count=10, save_count=1)
+    tel.event("resample", resample_batch=8, resample_duplicates_removed=1)
+
+    s = tel.epoch_summary(0)
+    assert set(s["phases"]) == {"train", "optimize", "eval"}
+    assert s["n_train"] == 32 and s["surrogate"] == "gpr"
+    assert s["n_generations"] == 10 and s["termination"] == "hvkn"
+    assert s["wall_s"] == 1.25 and s["resample_batch"] == 8
+    ev = s["eval"]
+    assert ev["eval_n"] == 10
+    assert ev["eval_min"] == 0.005 and ev["eval_max"] == 0.08
+    assert ev["eval_mean"] == pytest.approx(0.3 / 10)
+    json.dumps(s)
+
+
+def test_epoch_summary_aggregates_multiproblem_events():
+    """A multi-problem epoch emits one train/optimize event per
+    problem: counters must sum, ratio fields average, terminations
+    union, and gens_per_sec must be recomputed from the totals —
+    last-writer-wins paired one problem's throughput with the summed
+    durations."""
+    tel = Telemetry()
+    tel.set_epoch(0)
+    tel.event("phase", phase="train", duration_s=1.0, n_train=30,
+              surrogate_loss=2.0, surrogate="gpr")
+    tel.event("phase", phase="train", duration_s=3.0, n_train=10,
+              surrogate_loss=4.0, surrogate="gpr")
+    tel.event("phase", phase="optimize", duration_s=2.0, n_generations=10,
+              n_evals=100, termination="num_generations")
+    tel.event("phase", phase="optimize", duration_s=3.0, n_generations=15,
+              n_evals=150, termination="hvkn")
+    tel.event("resample", resample_batch=8, resample_duplicates_removed=1)
+    tel.event("resample", resample_batch=4, resample_duplicates_removed=2)
+
+    s = tel.epoch_summary(0)
+    assert s["phases"]["train"] == pytest.approx(4.0)
+    assert s["n_train"] == 40
+    assert s["surrogate_loss"] == pytest.approx(3.0)  # mean over problems
+    assert s["n_generations"] == 25 and s["n_evals"] == 250
+    assert s["gens_per_sec"] == pytest.approx(25 / 5.0)
+    assert s["termination"] == "num_generations+hvkn"
+    assert s["resample_batch"] == 12
+    assert s["resample_duplicates_removed"] == 3
+
+
+def test_epoch_summary_survives_ring_eviction():
+    """An event-heavy epoch (one eval drain per generation in
+    evaluation mode) must not evict its own early events from the
+    persisted summary: epoch_summary reads the complete per-epoch
+    index, not the bounded ring."""
+    tel = Telemetry(ring_size=4)
+    tel.set_epoch(0)
+    with tel.phase("train") as ph:
+        ph.update(n_train=32, surrogate="gpr")
+    for _ in range(20):  # far beyond the ring capacity
+        tel.event("phase", phase="eval", duration_s=0.01, n_evals=1,
+                  eval_min=0.01, eval_max=0.01, eval_sum=0.01)
+    assert len(tel.log) == 4  # the ring itself stays bounded
+    s = tel.epoch_summary(0)
+    assert s["n_train"] == 32 and "train" in s["phases"]
+    assert s["eval"]["eval_n"] == 20
+
+    # advancing the epoch prunes the old index; summaries for pruned
+    # epochs fall back to whatever the ring still holds
+    tel.set_epoch(1)
+    assert 0 not in tel._events_by_epoch
+    assert tel.epoch_summary(0)["eval"]["eval_n"] == 4
+
+
+def test_optimize_phase_excludes_eval_suspension():
+    """Evaluation-mode epochs suspend at `yield` while the driver runs
+    objective evaluations; that wall time belongs to the `eval` phase,
+    so the `optimize` duration / gens_per_sec must exclude it."""
+    import time as _time
+
+    from dmosopt_tpu import moasmo
+
+    rng = np.random.default_rng(3)
+    dim = 6
+    Xinit = rng.uniform(size=(40, dim)).astype(np.float32)
+
+    def eval_batch(X):
+        X = np.asarray(X)
+        f1 = X[:, 0]
+        g = 1.0 + 9.0 / (dim - 1) * np.sum(X[:, 1:], axis=1)
+        return np.stack([f1, g * (1.0 - np.sqrt(f1 / g))], axis=1)
+
+    tel = Telemetry()
+    tel.set_epoch(0)
+    gen = moasmo.epoch(
+        num_generations=4,
+        param_names=[f"x{i}" for i in range(dim)],
+        objective_names=["f1", "f2"],
+        xlb=np.zeros(dim), xub=np.ones(dim),
+        pct=0.25, Xinit=Xinit, Yinit=eval_batch(Xinit), C=None,
+        pop=16, optimizer_name="nsga2",
+        surrogate_method_name=None, local_random=5,
+        telemetry=tel,
+    )
+    sleep_per_round = 0.1
+    t_total0 = _time.perf_counter()
+    item = next(gen)
+    n_rounds = 0
+    while True:
+        x_gen, _ = item
+        _time.sleep(sleep_per_round)  # stand-in for slow objectives
+        n_rounds += 1
+        try:
+            item = gen.send((x_gen, eval_batch(x_gen), None))
+        except StopIteration:
+            break
+    t_total = _time.perf_counter() - t_total0
+    (ev,) = [
+        e for e in tel.log.records(kind="phase")
+        if e.fields.get("phase") == "optimize"
+    ]
+    # the reported optimize duration may include EA compile/compute but
+    # must NOT include the time this driver loop held the generator
+    # suspended at `yield` (n_rounds sleeps)
+    total_suspended = n_rounds * sleep_per_round
+    assert ev.fields["duration_s"] <= t_total - total_suspended + 0.05, (
+        ev.fields["duration_s"], t_total, total_suspended,
+    )
+    assert ev.fields["n_generations"] == 4
+    assert ev.fields["gens_per_sec"] == pytest.approx(
+        4 / ev.fields["duration_s"], rel=0.01
+    )
+
+
+# ----------------------------------------------------- metric catalog
+
+
+def test_every_emitted_metric_is_cataloged():
+    """The fast-suite arm of `make lint-metrics`: any metric name the
+    package emits must be documented in docs/observability.md."""
+    import importlib.util
+    import pathlib
+
+    tool = (
+        pathlib.Path(__file__).resolve().parent.parent
+        / "tools" / "lint_metrics.py"
+    )
+    spec = importlib.util.spec_from_file_location("lint_metrics", tool)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    missing = mod.check()
+    assert not missing, f"metrics missing from the catalog: {missing}"
+    assert len(mod.emitted_metrics()) > 0  # the scanner still finds emissions
+
+
+# --------------------------------------------- driver + storage + CLI
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    fp = tmp_path_factory.mktemp("telemetry") / "run.h5"
+    dmosopt_tpu.run(_run_params(fp), verbose=False)
+    return str(fp)
+
+
+def test_h5_telemetry_group_written(store):
+    from dmosopt_tpu.storage import load_telemetry_from_h5
+
+    summaries = load_telemetry_from_h5(store, "tel_run")
+    assert sorted(summaries) == [0, 1]
+    s0 = summaries[0]
+    # every acceptance phase made it to disk for the first epoch
+    assert {"xinit", "train", "optimize", "eval"} <= set(s0["phases"])
+    assert s0["n_train"] > 0 and s0["surrogate"] == "gpr"
+    assert s0["n_generations"] > 0 and s0["eval"]["eval_n"] > 0
+
+
+def test_h5_telemetry_survives_restore_cycle(store):
+    from dmosopt_tpu.storage import load_telemetry_from_h5
+
+    before = load_telemetry_from_h5(store, "tel_run")
+    # resume the archive for two more epochs: pre-restart summaries must
+    # survive and the resumed epochs must extend the history
+    dmosopt_tpu.run(_run_params(store, n_epochs=2), verbose=False)
+    after = load_telemetry_from_h5(store, "tel_run")
+    assert set(before) <= set(after)
+    assert max(after) > max(before)
+    for e in before:
+        assert set(before[e]["phases"]) <= set(after[e]["phases"])
+    # the resumed run's xinit phase is tagged with its first epoch —
+    # an epoch-0 tag would be pruned before any summary could keep it
+    first_resumed = min(set(after) - set(before))
+    assert "xinit" in after[first_resumed]["phases"]
+
+
+def test_cli_telemetry_table_and_export(store, tmp_path):
+    click = pytest.importorskip("click")
+    from click.testing import CliRunner
+    from dmosopt_tpu.cli import telemetry as telemetry_cmd
+
+    out = tmp_path / "telemetry.json"
+    result = CliRunner().invoke(
+        telemetry_cmd,
+        ["-p", store, "--opt-id", "tel_run", "--hv", "-o", str(out)],
+    )
+    assert result.exit_code == 0, result.output
+    lines = result.output.splitlines()
+    header = lines[0]
+    for col in ("epoch", "wall_s", "xinit", "train", "optimize",
+                "eval", "gens/s", "hv"):
+        assert col in header, header
+    # one row per stored epoch, first column is the epoch number
+    rows = [l for l in lines[2:] if l and not l.startswith("wrote")]
+    assert [int(r.split()[0]) for r in rows] == sorted(
+        int(k) for k in json.loads(out.read_text())
+    )
+    payload = json.loads(out.read_text())
+    assert payload["0"]["phases"]["optimize"] > 0
+    assert isinstance(payload["0"].get("hypervolume"), float)
+
+
+def test_cli_telemetry_missing_group_errors(tmp_path):
+    pytest.importorskip("click")
+    from click.testing import CliRunner
+    from dmosopt_tpu.cli import telemetry as telemetry_cmd
+
+    fp = tmp_path / "empty.h5"
+    with h5py.File(fp, "w") as h5:
+        h5.create_group("other_run")
+    result = CliRunner().invoke(
+        telemetry_cmd, ["-p", str(fp), "--opt-id", "other_run"]
+    )
+    assert result.exit_code != 0
+    assert "no telemetry group" in result.output
+
+
+def test_disabled_run_makes_zero_telemetry_calls(tmp_path, monkeypatch):
+    """telemetry=False: the driver holds no Telemetry at all — no
+    instance is even constructed, so the epoch loop cannot make a
+    telemetry call (acceptance criterion: zero calls on the hot path)."""
+
+    def _boom(*a, **k):
+        raise AssertionError("telemetry touched in a telemetry=False run")
+
+    monkeypatch.setattr(Telemetry, "__init__", _boom)
+    monkeypatch.setattr(MetricsRegistry, "counter_inc", _boom)
+    monkeypatch.setattr(MetricsRegistry, "gauge_set", _boom)
+    monkeypatch.setattr(MetricsRegistry, "histogram_observe", _boom)
+    monkeypatch.setattr(EventLog, "emit", _boom)
+
+    fp = tmp_path / "silent.h5"
+    dmosopt_tpu.run(
+        _run_params(
+            fp, telemetry=False, n_epochs=1, num_generations=5,
+            surrogate_method_name=None, n_initial=4, population_size=16,
+        ),
+        verbose=False,
+    )
+    with h5py.File(fp, "r") as h5:
+        assert "telemetry" not in h5["tel_run"]

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Metric-name lint: every metric emitted by the package must appear in
+the docs/observability.md catalog.
+
+Scans dmosopt_tpu/**/*.py for telemetry emission calls — the facade's
+``.inc(`` / ``.gauge(`` / ``.observe(`` and the registry's
+``.counter_inc(`` / ``.gauge_set(`` / ``.histogram_observe(`` — whose
+first argument is a string literal, and checks each name is backticked
+somewhere in the catalog doc. Run directly (exit 1 on missing names) or
+via ``make lint-metrics``; the fast test suite runs it too
+(tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "dmosopt_tpu"
+CATALOG = REPO / "docs" / "observability.md"
+
+# an emission: method call with a lowercase snake_case string literal as
+# the first argument (\s matches newlines, so wrapped calls count)
+EMIT_RE = re.compile(
+    r"\.(?:inc|gauge|observe|counter_inc|gauge_set|histogram_observe)"
+    r"\(\s*['\"]([a-z][a-z0-9_]*)['\"]"
+)
+
+
+def emitted_metrics(package_root: Path = PACKAGE) -> dict:
+    """{metric_name: [files emitting it]} across the package source."""
+    names: dict = {}
+    for path in sorted(package_root.rglob("*.py")):
+        for match in EMIT_RE.finditer(path.read_text()):
+            names.setdefault(match.group(1), []).append(
+                str(path.relative_to(REPO))
+            )
+    return names
+
+
+def catalog_names(doc_path: Path = CATALOG) -> set:
+    """Every backticked snake_case token in the catalog doc."""
+    return set(re.findall(r"`([a-z][a-z0-9_]*)`", doc_path.read_text()))
+
+
+def check(package_root: Path = PACKAGE, doc_path: Path = CATALOG) -> list:
+    """Return [(name, files)] for emitted metrics missing from the doc."""
+    catalog = catalog_names(doc_path)
+    return sorted(
+        (name, sorted(set(files)))
+        for name, files in emitted_metrics(package_root).items()
+        if name not in catalog
+    )
+
+
+def main() -> int:
+    emitted = emitted_metrics()
+    missing = check()
+    if missing:
+        print(f"lint-metrics: {len(missing)} metric name(s) missing from "
+              f"{CATALOG.relative_to(REPO)}:")
+        for name, files in missing:
+            print(f"  {name}  (emitted in {', '.join(files)})")
+        return 1
+    print(f"lint-metrics: OK — {len(emitted)} emitted metric name(s) all "
+          f"cataloged in {CATALOG.relative_to(REPO)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,11 @@ NSGA2 strategy loop (generate/update per generation, pop=200 dim=30 on
 raw ZDT1) and a GPR_Matern + SCE-UA fit on N=200 — same methodology as
 BASELINE.md "Measured" (drive the strategy directly, no MPI).
 
+Timing is best-of-2 after one untimed warm-up pass, mirroring
+bench.py::bench_zdt1_nsga2 exactly, so the headline reference/ours
+ratio compares like with like (shared-host scheduling noise is ~30%;
+min-of-2 on both sides keeps the ratio symmetric).
+
 Run: env PYTHONPATH=$PWD:/root/reference JAX_PLATFORMS=cpu python measure_config1.py
 """
 import json
@@ -22,6 +27,20 @@ def zdt1(x):
     return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
 
 
+def time_nsga2_loop(x0, y0, bounds, dim, pop, ngen, seed):
+    model = Struct(feasibility=None)
+    opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=model)
+    opt.initialize_strategy(
+        x0, y0, bounds, local_random=np.random.default_rng(seed)
+    )
+    t0 = time.perf_counter()
+    for _ in range(ngen):
+        x_gen, state = opt.generate()
+        y_gen = np.apply_along_axis(zdt1, 1, x_gen)
+        opt.update(x_gen, y_gen, state)
+    return time.perf_counter() - t0
+
+
 def main():
     dim, pop, ngen = 30, 200, 60
     rng = np.random.default_rng(42)
@@ -29,26 +48,28 @@ def main():
     y0 = np.apply_along_axis(zdt1, 1, x0)
     bounds = np.column_stack([np.zeros(dim), np.ones(dim)])
 
-    model = Struct(feasibility=None)
-    opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=model)
-    opt.initialize_strategy(x0, y0, bounds, local_random=rng)
-    t0 = time.perf_counter()
-    for _ in range(ngen):
-        x_gen, state = opt.generate()
-        y_gen = np.apply_along_axis(zdt1, 1, x_gen)
-        opt.update(x_gen, y_gen, state)
-    gens_per_sec = ngen / (time.perf_counter() - t0)
+    # warm-up pass (caches, allocator), then best-of-2 timed runs —
+    # same shape as bench.py's compile warm-up + best-of-2
+    time_nsga2_loop(x0, y0, bounds, dim, pop, ngen=5, seed=7)
+    best_wall = min(
+        time_nsga2_loop(x0, y0, bounds, dim, pop, ngen, seed)
+        for seed in (8, 9)
+    )
+    gens_per_sec = ngen / best_wall
 
     xin = rng.uniform(size=(200, dim))
     yin = np.apply_along_axis(zdt1, 1, xin)
-    t0 = time.perf_counter()
-    GPR_Matern(xin, yin, dim, 2, np.zeros(dim), np.ones(dim))
-    gp_fit_sec = time.perf_counter() - t0
+    gp_fit_sec = float("inf")
+    for _ in range(2):  # best of 2, matching the framework's warm fit
+        t0 = time.perf_counter()
+        GPR_Matern(xin, yin, dim, 2, np.zeros(dim), np.ones(dim))
+        gp_fit_sec = min(gp_fit_sec, time.perf_counter() - t0)
 
     print(json.dumps({
         "gens_per_sec": round(gens_per_sec, 2),
         "gp_fit_sec": round(gp_fit_sec, 2),
         "ngen": ngen,
+        "timing": "best-of-2",
     }))
 
 
