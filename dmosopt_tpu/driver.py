@@ -41,7 +41,12 @@ from dmosopt_tpu.datatypes import (
     StrategyState,
     update_nested_dict,
 )
-from dmosopt_tpu.parallel.evaluator import HostFunEvaluator, JaxBatchEvaluator
+from dmosopt_tpu.parallel.evaluator import (
+    EvalFailure,
+    HostFunEvaluator,
+    JaxBatchEvaluator,
+)
+from dmosopt_tpu.parallel.pipeline import BackgroundWriter, PipelineConfig
 from dmosopt_tpu.strategy import DistOptStrategy
 from dmosopt_tpu.telemetry import Telemetry, create_telemetry, record_device_memory
 from dmosopt_tpu.utils.prng import as_generator
@@ -121,6 +126,32 @@ def eval_obj_fun_mp(
 # ----------------------------------------------------------------- driver
 
 
+class _InflightBatch:
+    """One asynchronously submitted evaluation batch mid-collection.
+
+    Results arrive from the handle in COMPLETION order; they buffer here
+    and fold into the strategies in SUBMISSION order (``next_fold`` is
+    the first round not yet folded), so the archive's row order is
+    independent of which objective call finished first. ``blocked``
+    accumulates the wall seconds the driver actually spent waiting in
+    ``poll`` — the difference against the handle's total lifetime is the
+    evaluation time hidden behind driver work (the overlap the pipeline
+    exists to create)."""
+
+    __slots__ = ("handle", "task_reqs", "buffered", "next_fold", "blocked")
+
+    def __init__(self, handle, task_reqs):
+        self.handle = handle
+        self.task_reqs = task_reqs
+        self.buffered = {}
+        self.next_fold = 0
+        self.blocked = 0.0
+
+    @property
+    def total(self) -> int:
+        return len(self.task_reqs)
+
+
 class DistOptimizer:
     def __init__(
         self,
@@ -154,6 +185,7 @@ class DistOptimizer:
         metadata=None,
         # execution backend (TPU-specific)
         jax_objective=False, evaluator=None, n_eval_workers=1, mesh=None,
+        pipeline=None,
         # observability
         telemetry=None,
         verbose=False,
@@ -171,6 +203,16 @@ class DistOptimizer:
             axis over the mesh's first axis, SPMD with XLA collectives)
             and, with jax_objective, the batch evaluation.
           n_eval_workers: thread-pool width for host objectives.
+          pipeline: epoch-pipeline mode — ``"serial"`` (fully
+            synchronous legacy loop), ``"overlap_io"`` (default:
+            background persistence writer + streaming result
+            collection; archives stay byte-identical to serial),
+            ``"speculative"`` (additionally start the surrogate fit at
+            a quorum fraction of the resample batch), or a dict /
+            `dmosopt_tpu.parallel.pipeline.PipelineConfig` with
+            ``quorum_fraction``, ``eval_timeout``, ``eval_retries``,
+            ``on_eval_failure``, ``jax_eval_chunks`` — see
+            docs/parallel.md.
           telemetry: None/True for the on-by-default metrics + event log,
             False for none at all (zero telemetry calls on the hot
             path), a dict of `dmosopt_tpu.telemetry.Telemetry` kwargs
@@ -230,6 +272,19 @@ class DistOptimizer:
         )
         self.save_surrogate_evals_ = save_surrogate_evals
         self.save_optimizer_params_ = save_optimizer_params
+        self.pipeline = PipelineConfig.from_spec(pipeline)
+        if self.pipeline.on_eval_failure == "skip" and surrogate_method_name is None:
+            # no-surrogate mode evaluates each EA generation for real:
+            # the epoch generator sends y back row-matched to the x_gen
+            # it yielded, so silently dropping one round would misalign
+            # (or shape-error) every row after it inside optimizer.update
+            raise ValueError(
+                "on_eval_failure='skip' requires a surrogate "
+                "(surrogate_method_name=None evaluates whole generations "
+                "whose results must stay row-aligned)"
+            )
+        self._writer = None  # lazy BackgroundWriter (overlap modes only)
+        self._inflight = []  # _InflightBatch stragglers awaiting reconcile
         self.telemetry = create_telemetry(telemetry)
         # a pass-through user instance may be shared across runs (one
         # JSONL sink for a sweep); only instances created here are
@@ -378,6 +433,9 @@ class DistOptimizer:
             nested_parameter_space, self.obj_fun_args, target,
         )
 
+        # like telemetry, only evaluators built here are closed by run():
+        # a user-supplied instance may be shared across runs
+        self._owns_evaluator = evaluator is None
         self.evaluator = evaluator if evaluator is not None else (
             # the distwq replacement: one jitted mesh-sharded batch call
             # for jax objectives, a thread pool for host objectives
@@ -615,6 +673,33 @@ class DistOptimizer:
     # analogue of the reference's rank-0 distwq controller owning the H5
     # writes (reference dmosopt.py:2518-2536).
 
+    def _submit_write(self, fn, *args, **kwargs):
+        """One persistence write: executed inline in serial mode, queued
+        to the ordered background writer in the overlap modes. Arguments
+        are fully materialized by the caller before submission (snapshot
+        semantics), and the writer executes closures strictly in
+        submission order — the checkpoint file goes through the identical
+        sequence of states the serial loop produces; the pipeline changes
+        when the driver blocks, never what is written."""
+        if not self.pipeline.overlaps_io:
+            fn(*args, **kwargs)
+            return
+        if self._writer is None:
+            self._writer = BackgroundWriter(telemetry=self.telemetry)
+        self._writer.submit(fn, *args, **kwargs)
+
+    def _flush_writes(self):
+        """Block until every queued persistence write has hit the file;
+        called before any state a restart could observe (end of each
+        epoch, run teardown)."""
+        if self._writer is not None:
+            self._writer.flush()
+
+    def _close_writer(self):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
     def save_evals(self):
         """Store results of finished evals to file
         (reference dmosopt.py:962-1015)."""
@@ -644,7 +729,10 @@ class DistOptimizer:
                 self.storage_dict[problem_id] = []
 
         if len(finished_evals) > 0 and _is_primary_process():
-            save_to_h5(
+            # finished_evals is a snapshot (the live lists were reset
+            # above), so the write is safe to run behind the epoch loop
+            self._submit_write(
+                save_to_h5,
                 self.opt_id, self.problem_ids, self.has_problem_ids,
                 self.objective_names, self.feature_dtypes, self.constraint_names,
                 self.param_space, finished_evals,
@@ -662,7 +750,8 @@ class DistOptimizer:
         if x_sm.shape[0] > 0 and _is_primary_process():
             from dmosopt_tpu.storage import save_surrogate_evals_to_h5
 
-            save_surrogate_evals_to_h5(
+            self._submit_write(
+                save_surrogate_evals_to_h5,
                 self.opt_id, problem_id, self.param_names,
                 self.objective_names, epoch, gen_index, x_sm, y_sm,
                 self.file_path, self.logger,
@@ -673,7 +762,8 @@ class DistOptimizer:
             return
         from dmosopt_tpu.storage import save_optimizer_params_to_h5
 
-        save_optimizer_params_to_h5(
+        self._submit_write(
+            save_optimizer_params_to_h5,
             self.opt_id, problem_id, epoch, optimizer_name, optimizer_params,
             self.file_path, self.logger,
         )
@@ -683,7 +773,9 @@ class DistOptimizer:
             return
         from dmosopt_tpu.storage import save_stats_to_h5
 
-        save_stats_to_h5(
+        # get_stats() runs NOW (snapshot); only the file write is deferred
+        self._submit_write(
+            save_stats_to_h5,
             self.opt_id, problem_id, epoch, self.file_path, self.logger,
             self.get_stats(),
         )
@@ -696,7 +788,8 @@ class DistOptimizer:
             return
         from dmosopt_tpu.storage import save_telemetry_to_h5
 
-        save_telemetry_to_h5(
+        self._submit_write(
+            save_telemetry_to_h5,
             self.opt_id, epoch, self.telemetry.epoch_summary(epoch),
             self.file_path, self.logger,
         )
@@ -765,87 +858,259 @@ class DistOptimizer:
             and (time.time() - self.start_time) >= self.time_limit
         )
 
-    def _process_requests(self):
-        """Drain all pending evaluation requests through the evaluation
+    def _gather_rounds(self):
+        """Pop every pending request into evaluation rounds: one request
+        per problem id per round (so multi-problem tasks share an
+        evaluation call, matching eval_obj_fun_mp). Partial rounds are
+        allowed: per-problem queues can have unequal lengths (e.g.
+        resample dedupe dropped different counts), and the evaluation
+        wrappers iterate only the problems present in the submitted
+        dict. Returns (task_args, task_reqs)."""
+        task_args, task_reqs = [], []
+        while True:
+            round_reqs = {}
+            round_coords = {}
+            for problem_id in self.problem_ids:
+                req = self.optimizer_dict[problem_id].get_next_request()
+                if req is None:
+                    continue  # this problem's queue is drained
+                round_reqs[problem_id] = req
+                round_coords[problem_id] = req.parameters
+            if not round_reqs:
+                break
+            task_args.append(round_coords)
+            task_reqs.append(round_reqs)
+        return task_args, task_reqs
+
+    def _fold_round(self, res, round_reqs, round_times):
+        """Fold one completed evaluation round into the strategies and
+        the save queue (reduce_fun, per-problem complete_request,
+        storage append, eval accounting)."""
+        if self.reduce_fun is not None:
+            res = (
+                self.reduce_fun(res)
+                if self.reduce_fun_args is None
+                else self.reduce_fun(res, *self.reduce_fun_args)
+            )
+        t = res.pop("time", -1.0) if isinstance(res, dict) else -1.0
+        round_times.append(t)
+        for problem_id, rres in res.items():
+            eval_req = round_reqs[problem_id]
+            kwargs = {}
+            if (
+                self.feature_names is not None
+                and self.constraint_names is not None
+            ):
+                y, kwargs["f"], kwargs["c"] = rres[0], rres[1], rres[2]
+            elif self.feature_names is not None:
+                y, kwargs["f"] = rres[0], rres[1]
+            elif self.constraint_names is not None:
+                y, kwargs["c"] = rres[0], rres[1]
+            else:
+                y = rres
+            entry = self.optimizer_dict[problem_id].complete_request(
+                eval_req.parameters,
+                np.asarray(y),
+                pred=eval_req.prediction,
+                epoch=eval_req.epoch,
+                time=t,
+                **kwargs,
+            )
+            self.storage_dict[problem_id].append(entry)
+            if self.verbose:
+                prms = list(zip(self.param_names, list(eval_req.parameters.T)))
+                lres = list(zip(self.objective_names, np.asarray(y).T))
+                self.logger.info(
+                    f"problem id {problem_id}: optimization epoch "
+                    f"{eval_req.epoch}: parameters {prms}: {lres}"
+                )
+        self.eval_count += 1
+
+    def _handle_eval_failure(self, round_index, failure: EvalFailure):
+        """A round exhausted its timeout/retry budget. Policy "raise"
+        matches the serial loop (the whole run aborts); "skip" drops
+        only this round — no archive row, no eval_count — and the batch
+        survives (the handle already counted `eval_failures_total`)."""
+        if self.pipeline.on_eval_failure == "raise":
+            raise RuntimeError(
+                f"evaluation round {round_index} failed terminally after "
+                f"{failure.n_attempts} attempt(s) "
+                f"({'timeout' if failure.timed_out else failure.error!r})"
+            ) from failure.error
+        self.logger.warning(
+            f"evaluation round {round_index} skipped after "
+            f"{failure.n_attempts} attempt(s): {failure!r}"
+        )
+
+    def _fold_ready(self, st: _InflightBatch, round_times):
+        """Fold every buffered round that has become foldable — strictly
+        in submission order, so archives are independent of completion
+        order."""
+        while st.next_fold in st.buffered:
+            res = st.buffered.pop(st.next_fold)
+            round_reqs = st.task_reqs[st.next_fold]
+            st.next_fold += 1
+            if isinstance(res, EvalFailure):
+                self._handle_eval_failure(st.next_fold - 1, res)
+                continue
+            self._fold_round(res, round_reqs, round_times)
+
+    def _advance_inflight(self, st: _InflightBatch, round_times, until):
+        """Block until at least `until` rounds of `st` are folded (or the
+        time limit / handle exhaustion intervenes), accounting the wall
+        seconds actually spent waiting."""
+        self._fold_ready(st, round_times)
+        while st.next_fold < until and not self._time_exceeded():
+            t0 = time.perf_counter()
+            item = st.handle.poll(timeout=1.0)
+            st.blocked += time.perf_counter() - t0
+            if item is None:
+                if st.handle.done:
+                    break  # exhausted (e.g. cancelled requests): no more
+                continue
+            index, res = item
+            st.buffered[index] = res
+            self._fold_ready(st, round_times)
+
+    def _finish_inflight_telemetry(self, st: _InflightBatch):
+        """Overlap accounting once a batch is fully reconciled: the
+        handle lived (submit -> last fold) `wall` seconds, of which the
+        driver only waited `st.blocked` — the remainder ran concurrently
+        with surrogate fits, EA generations, or persistence."""
+        tel = self.telemetry
+        if not tel:
+            return
+        # the handle records when its LAST result landed; a straggler
+        # batch reconciled long afterwards must not count that idle gap
+        # as overlapped evaluation
+        t_end = st.handle.t_done
+        if t_end is None:
+            t_end = time.perf_counter()
+        wall = t_end - st.handle.t_submit
+        overlap = max(wall - st.blocked, 0.0)
+        tel.observe("eval_wait_seconds", st.blocked)
+        tel.observe("eval_overlap_seconds", overlap)
+        if wall > 0:
+            tel.gauge("pipeline_overlap_ratio", overlap / wall)
+        tel.event(
+            "pipeline", mode=self.pipeline.mode, n_rounds=st.total,
+            wait_s=st.blocked, overlap_s=overlap,
+        )
+
+    def _abandon_inflight(self):
+        """Soft-stop teardown: fold every result that has ALREADY
+        completed (no further waiting), cancel what never started, drop
+        the rest — the overlap-mode analogue of the serial soft stop,
+        which folds its whole blocking batch but abandons unevaluated
+        requests. The failure policy is not applied (the run is already
+        ending); the salvaged rows are saved like any others."""
+        round_times = []
+        for st in self._inflight:
+            # drain_completed, not poll: a zero-timeout poll could still
+            # run the expiry path and START a retry attempt — a fresh
+            # objective call launched during teardown would outlive the
+            # driver and race the HDF5 teardown
+            for index, res in st.handle.drain_completed():
+                st.buffered[index] = res
+            # fold PAST gaps, unlike _fold_ready: a still-running round
+            # must not discard finished later ones. Ascending index
+            # keeps submission order among the rounds that completed;
+            # the run is ending, so nothing depends on next_fold after
+            # this. Failures are dropped (nothing left to abort)
+            for index in sorted(st.buffered):
+                res = st.buffered.pop(index)
+                if not isinstance(res, EvalFailure):
+                    self._fold_round(res, st.task_reqs[index], round_times)
+            st.handle.cancel_pending()
+        self._inflight = []
+        if self.save and self.saved_eval_count < self.eval_count:
+            self.save_evals()
+            self.saved_eval_count = self.eval_count
+
+    def _use_async(self) -> bool:
+        """Stream results through submit_batch? Overlap modes only, and
+        only for backends exposing the async API (external evaluate_batch
+        -only evaluators keep the blocking path; the background writer
+        still applies)."""
+        return self.pipeline.overlaps_io and hasattr(
+            self.evaluator, "submit_batch"
+        )
+
+    def _process_requests(self, allow_quorum: bool = False):
+        """Drain pending evaluation requests through the evaluation
         backend. Replaces the reference's MPI submit/probe polling loop
-        (dmosopt.py:1152-1339) with batched synchronous evaluation: each
-        round gathers one request per problem id (so multi-problem tasks
-        share an evaluation call, matching eval_obj_fun_mp), batches all
-        rounds, and evaluates them in one backend call."""
+        (dmosopt.py:1152-1339).
+
+        Serial mode evaluates each gathered batch in one blocking
+        backend call. The overlap modes submit the batch asynchronously
+        and fold results as they stream back (submission order, so
+        archives match serial byte for byte). With ``allow_quorum`` in
+        speculative mode, the drain returns once the configured quorum
+        fraction of rounds has folded; the stragglers stay in flight —
+        overlapping the surrogate fit that follows — and are reconciled
+        at the start of the next drain, entering the next training set."""
         tel = self.telemetry
         t_drain0 = time.perf_counter()
         evals_before = self.eval_count
         round_times = []
+
+        # reconcile stragglers a speculative drain left in flight: they
+        # must land (in submission order) before this drain's new batch.
+        # A time-limit expiry mid-reconcile keeps the batch parked so
+        # the teardown salvage (_abandon_inflight) still sees it
+        still_inflight = []
+        for st in self._inflight:
+            self._advance_inflight(st, round_times, st.total)
+            if st.next_fold < st.total:
+                still_inflight.append(st)
+            else:
+                self._finish_inflight_telemetry(st)
+        self._inflight = still_inflight
+
         has_requests = any(
             self.optimizer_dict[pid].has_requests() for pid in self.problem_ids
         )
 
         while has_requests and not self._time_exceeded():
-            task_args = []
-            task_reqs = []
-            while True:
-                round_reqs = {}
-                round_coords = {}
-                for problem_id in self.problem_ids:
-                    req = self.optimizer_dict[problem_id].get_next_request()
-                    if req is None:
-                        continue  # this problem's queue is drained
-                    round_reqs[problem_id] = req
-                    round_coords[problem_id] = req.parameters
-                if not round_reqs:
-                    break
-                # partial rounds are allowed: per-problem queues can have
-                # unequal lengths (e.g. resample dedupe dropped different
-                # counts), and the evaluation wrappers iterate only the
-                # problems present in the submitted dict
-                task_args.append(round_coords)
-                task_reqs.append(round_reqs)
-
+            task_args, task_reqs = self._gather_rounds()
             if not task_args:
                 break
 
-            results = self.evaluator.evaluate_batch(task_args)
-
-            for res, round_reqs in zip(results, task_reqs):
-                if self.reduce_fun is not None:
-                    res = (
-                        self.reduce_fun(res)
-                        if self.reduce_fun_args is None
-                        else self.reduce_fun(res, *self.reduce_fun_args)
+            if self._use_async():
+                cfg = self.pipeline
+                st = _InflightBatch(
+                    self.evaluator.submit_batch(
+                        task_args, timeout=cfg.eval_timeout,
+                        retries=cfg.eval_retries, n_chunks=cfg.jax_eval_chunks,
+                    ),
+                    task_reqs,
+                )
+                quorum = st.total
+                if allow_quorum and cfg.speculative and self.epoch_count > 0:
+                    # never speculate on the initial design (epoch 0 /
+                    # first epoch after resume): the first surrogate fit
+                    # sees the full design, exactly like serial
+                    quorum = max(
+                        1, int(np.ceil(cfg.quorum_fraction * st.total))
                     )
-                t = res.pop("time", -1.0) if isinstance(res, dict) else -1.0
-                round_times.append(t)
-                for problem_id, rres in res.items():
-                    eval_req = round_reqs[problem_id]
-                    kwargs = {}
-                    if (
-                        self.feature_names is not None
-                        and self.constraint_names is not None
-                    ):
-                        y, kwargs["f"], kwargs["c"] = rres[0], rres[1], rres[2]
-                    elif self.feature_names is not None:
-                        y, kwargs["f"] = rres[0], rres[1]
-                    elif self.constraint_names is not None:
-                        y, kwargs["c"] = rres[0], rres[1]
-                    else:
-                        y = rres
-                    entry = self.optimizer_dict[problem_id].complete_request(
-                        eval_req.parameters,
-                        np.asarray(y),
-                        pred=eval_req.prediction,
-                        epoch=eval_req.epoch,
-                        time=t,
-                        **kwargs,
-                    )
-                    self.storage_dict[problem_id].append(entry)
-                    if self.verbose:
-                        prms = list(zip(self.param_names, list(eval_req.parameters.T)))
-                        lres = list(zip(self.objective_names, np.asarray(y).T))
-                        self.logger.info(
-                            f"problem id {problem_id}: optimization epoch "
-                            f"{eval_req.epoch}: parameters {prms}: {lres}"
+                self._advance_inflight(st, round_times, quorum)
+                if st.next_fold < st.total:
+                    # quorum reached (or soft time-limit stop): the rest
+                    # keep evaluating behind the caller's surrogate fit
+                    self._inflight.append(st)
+                    # count only genuine quorum returns — a time-limit
+                    # stop parks the batch too but is not speculation
+                    if tel and st.next_fold >= quorum:
+                        tel.inc("eval_quorum_returns_total")
+                        tel.inc(
+                            "eval_stragglers_total", st.total - st.next_fold
                         )
-                self.eval_count += 1
+                else:
+                    self._finish_inflight_telemetry(st)
+            else:
+                results = self.evaluator.evaluate_batch(task_args)
+                for res, round_reqs in zip(results, task_reqs):
+                    self._fold_round(res, round_reqs, round_times)
 
             if (
                 self.save
@@ -853,6 +1118,9 @@ class DistOptimizer:
             ):
                 self.save_evals()
                 self.saved_eval_count = self.eval_count
+
+            if self._inflight:
+                break  # quorum return: caller proceeds to the fit now
 
             has_requests = any(
                 self.optimizer_dict[pid].has_requests() for pid in self.problem_ids
@@ -962,7 +1230,10 @@ class DistOptimizer:
 
         with trace_ctx:
             self.stats["init_sampling_start"] = time.time()
-            self._process_requests()
+            # the epoch-opening drain evaluates the previous epoch's
+            # resample batch — the one place speculative mode may return
+            # at quorum so the surrogate fit below overlaps the stragglers
+            self._process_requests(allow_quorum=True)
             for strat in self.optimizer_dict.values():
                 if self.dynamic_initial_sampling is not None and self.epoch_count == 0:
                     self._drain_dynamic_initial_samples(strat)
@@ -1003,6 +1274,11 @@ class DistOptimizer:
                 save_count=self.save_count,
             )
             self.save_telemetry(epoch)
+
+        # exact persistence semantics: every write queued this epoch is
+        # on disk before the epoch is considered done (a restart can
+        # never observe a state the serial loop couldn't produce)
+        self._flush_writes()
 
         self.epoch_count += 1
         return self.epoch_count
@@ -1100,6 +1376,7 @@ def run(
         dopt_params["time_limit"] = time_limit
     dopt = dopt_init(dopt_params, verbose=verbose, initialize_strategy=True)
     dopt.logger.info(f"Optimizing for {dopt.n_epochs} epochs...")
+    body_ok = False
     try:
         if dopt.n_epochs <= 0:
             dopt.run_epoch(completed_epoch=True)
@@ -1117,13 +1394,41 @@ def run(
             dopt.telemetry.gauge("compile_cache_misses", cs["misses"])
             dopt.telemetry.event("compile_cache", **cs)
             record_device_memory(dopt.telemetry)
+        body_ok = True
     finally:
-        # only close a Telemetry this run created: a pass-through
-        # user-supplied instance may be shared across runs (one JSONL
-        # sink for a sweep) and closing it would silently drop the
-        # next run's events
-        if dopt.telemetry is not None and dopt._owns_telemetry:
-            dopt.telemetry.close()
+        # teardown order matters: salvage already-completed results a
+        # soft stop left in flight, drain the evaluator (in-flight
+        # objective calls may hold file handles that must not race the
+        # checkpoint), then land every queued persistence write, then
+        # close telemetry
+        # each step exception-isolated: a failing evaluator close must
+        # not strand the writer queue (salvaged rows would never reach
+        # the file) nor leak the telemetry sink
+        try:
+            dopt._abandon_inflight()
+        except Exception:
+            dopt.logger.exception("discarding in-flight results failed")
+        try:
+            if dopt._owns_evaluator and hasattr(dopt.evaluator, "close"):
+                dopt.evaluator.close()
+        except Exception:
+            dopt.logger.exception("evaluator close failed")
+        try:
+            dopt._close_writer()
+        except Exception:
+            # a write failure surfacing at close matters on a clean run,
+            # but must not displace the exception that actually killed
+            # an aborted one
+            if body_ok:
+                raise
+            dopt.logger.exception("background writer close failed")
+        finally:
+            # only close a Telemetry this run created: a pass-through
+            # user-supplied instance may be shared across runs (one JSONL
+            # sink for a sweep) and closing it would silently drop the
+            # next run's events
+            if dopt.telemetry is not None and dopt._owns_telemetry:
+                dopt.telemetry.close()
     return dopt.get_best(
         feasible=feasible, return_features=return_features,
         return_constraints=return_constraints,

@@ -463,11 +463,16 @@ def optimize(
                 logger.info(
                     f"{optimizer.name}: generation {i} of {num_generations}..."
                 )
-            x_gen, state_gen = optimizer.generate()
-            x_gen = _as_np(x_gen)
+            x_gen_dev, state_gen = optimizer.generate()
+            # suspension/resume boundary with the evaluator: the HOST
+            # copy goes out for objective evaluation, but the update
+            # keeps the DEVICE-resident offspring — re-uploading the
+            # numpy copy was a full-batch host->device round-trip per
+            # generation on the eval-bound path
+            x_gen = _as_np(x_gen_dev)
             y_gen = yield x_gen
             y_gen = np.asarray(y_gen, dtype=np.float32)
-            optimizer.update(x_gen, y_gen, state_gen)
+            optimizer.update(x_gen_dev, y_gen, state_gen)
             n_eval += x_gen.shape[0]
             x_new.append(x_gen)
             y_new.append(y_gen)

@@ -8,6 +8,14 @@ the full dominance degree matrix is a single broadcast-compare-reduce over an
 ``(N, N, d)`` tensor — no sorting, no Python loops. Front assignment peels
 ranks with a ``lax.while_loop`` (one iteration per front, not per point).
 
+Bi-objective populations take a different route entirely: for d == 2 the
+front index equals the patience-sorting pile index over the population
+sorted by (f1, f2) — an O(N log N) scanned sweep (Jensen's bi-objective
+ENS specialization) that never materializes the (N, N) matrix. At the
+flagship SMPSO scale (5 swarms x 12288 candidates) this is ~20x faster
+than the peeled matrix on CPU and produces *bitwise identical* ranks
+(pinned by tests/test_ops.py), so every d == 2 trajectory is unchanged.
+
 All functions are shape-static and mask-aware so populations can live in
 fixed-capacity arrays (masked slots get rank ``n``).
 """
@@ -35,6 +43,70 @@ def dominance_degree_matrix(Y: jax.Array) -> jax.Array:
     return (Y[:, None, :] <= Y[None, :, :]).sum(axis=-1).astype(jnp.int32)
 
 
+def _rank_biobjective_sweep(Y: jax.Array, mask: jax.Array | None) -> jax.Array:
+    """Exact non-dominated ranks for d == 2 as a patience-sorting sweep.
+
+    Sorted by (f1 asc, f2 asc), every already-processed point weakly
+    dominates the current one iff its f2 is <= the current f2 (identical
+    rows excepted), so the front index is the patience pile index over
+    f2: the first front whose minimum f2 exceeds f2_j. The pile minima
+    stay sorted, so each point costs one ``searchsorted`` plus one
+    scatter — O(N log N) total versus the matrix peel's O(fronts * N^2).
+
+    Tie semantics match the matrix path exactly: identical rows do not
+    dominate each other (they share a front — the carry shortcut below),
+    and any row containing NaN neither dominates nor is dominated, so it
+    lands in front 0 like the matrix path's first peel.
+    """
+    n, _ = Y.shape
+    f1, f2 = Y[:, 0], Y[:, 1]
+    row_nan = jnp.isnan(Y).any(axis=1)
+    valid = jnp.ones((n,), bool) if mask is None else mask.astype(bool)
+    # rows outside the sweep (masked or NaN) sort last so they can never
+    # sit between two identical valid rows nor touch the pile minima
+    skip = row_nan | ~valid
+    perm = jnp.lexsort((f2, f1, skip.astype(jnp.int32)))
+    f1s, f2s, skips = f1[perm], f2[perm], skip[perm]
+
+    def body(carry, inp):
+        m, nfronts, prev1, prev2, prevk = carry
+        a, b, sk = inp
+        # first front whose min-f2 is strictly above b; clamp to the next
+        # unopened front so the +inf empty-front sentinel can never count
+        # a real +inf objective as dominated by an empty front
+        k = jnp.minimum(
+            jnp.searchsorted(m, b, side="right").astype(jnp.int32), nfronts
+        )
+        same = (a == prev1) & (b == prev2)
+        k = jnp.where(same, prevk, k)
+        # identical rows share a front and the pile minimum is already b;
+        # skipped rows touch nothing (their k is discarded below)
+        upd = jnp.where(sk | same, n, k)
+        m = m.at[upd].set(b, mode="drop")
+        nfronts = jnp.where(sk | same, nfronts, jnp.maximum(nfronts, k + 1))
+        carry = (
+            m,
+            nfronts,
+            jnp.where(sk, prev1, a),
+            jnp.where(sk, prev2, b),
+            jnp.where(sk, prevk, k),
+        )
+        return carry, k
+
+    dt = f2s.dtype
+    init = (
+        jnp.full((n,), jnp.inf, dt),
+        jnp.int32(0),
+        jnp.full((), jnp.nan, f1s.dtype),  # NaN: never equal, so the
+        jnp.full((), jnp.nan, dt),  # carry shortcut can't fire first
+        jnp.int32(0),
+    )
+    _, ks = jax.lax.scan(body, init, (f1s, f2s, skips))
+    rank = jnp.zeros((n,), jnp.int32).at[perm].set(ks)
+    rank = jnp.where(row_nan & valid, 0, rank)
+    return jnp.where(valid, rank, n)
+
+
 @partial(jax.jit, static_argnames=("stop_count",))
 def non_dominated_rank(
     Y: jax.Array,
@@ -55,9 +127,26 @@ def non_dominated_rank(
         the fronts covering ``k``, and each peel is a full (n, n)
         reduction. Leftover valid points get rank ``n - 1`` (a legal
         segment index, ordered after every exactly-ranked front; relative
-        order beyond the cut is unspecified).
+        order beyond the cut is unspecified). The bi-objective sweep
+        ignores it — exact ranks everywhere are cheaper than any stopped
+        peel, and exact-beyond-the-cut is a legal refinement of the
+        unspecified-beyond-cut contract.
     Returns (n,) int32 ranks.
     """
+    n, d = Y.shape
+    if d == 2 and jnp.issubdtype(Y.dtype, jnp.floating):
+        return _rank_biobjective_sweep(Y, mask)
+    return _rank_matrix_peel(Y, mask, stop_count)
+
+
+def _rank_matrix_peel(
+    Y: jax.Array,
+    mask: jax.Array | None = None,
+    stop_count: int | None = None,
+) -> jax.Array:
+    """General-d rank via the dominance degree matrix + front peeling
+    (see `non_dominated_rank` for the contract). The d == 2 sweep is
+    equivalence-pinned against this path in tests/test_ops.py."""
     n, d = Y.shape
     D = dominance_degree_matrix(Y)
     # Identical vectors: D[i,j] == D[j,i] == d -> neither dominates

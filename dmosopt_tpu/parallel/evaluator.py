@@ -17,17 +17,349 @@ workers" is either
 
 Both produce result dicts shaped exactly like the reference worker
 protocol: ``{problem_id: result, "time": seconds}``.
+
+Both also expose an asynchronous API for the overlapped epoch pipeline:
+``submit_batch()`` returns an `AsyncEvalHandle` whose results stream
+back as they complete — per-request futures with a configurable
+timeout/retry budget for host objectives, equally-shaped device chunks
+dispatched without any ``block_until_ready`` for jax objectives — so
+one slow or dead objective call no longer stalls the whole epoch. A
+request that exhausts its retries is delivered as an `EvalFailure`
+marker; the rest of the batch is unaffected.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+
+class EvalFailure:
+    """Terminal failure of ONE evaluation request (the batch survives).
+
+    Delivered through `AsyncEvalHandle.poll` in place of a result dict
+    once a request has exhausted its retry budget — either each attempt
+    raised (`error` holds the last exception) or each attempt exceeded
+    the per-request timeout (`timed_out`).
+    """
+
+    __slots__ = ("error", "n_attempts", "timed_out")
+
+    def __init__(self, error, n_attempts: int, timed_out: bool = False):
+        self.error = error
+        self.n_attempts = n_attempts
+        self.timed_out = timed_out
+
+    def __repr__(self):
+        cause = "timeout" if self.timed_out else repr(self.error)
+        return f"EvalFailure({cause}, attempts={self.n_attempts})"
+
+
+class AsyncEvalHandle:
+    """Streaming handle for one submitted evaluation batch.
+
+    ``poll(timeout)`` returns the next completed ``(index, result)`` in
+    COMPLETION order (``index`` is the request's position in the
+    submitted batch; ``result`` is a worker-protocol dict or an
+    `EvalFailure`), or None when nothing completed within ``timeout``
+    seconds. Callers needing submission order buffer and reorder — the
+    driver does, so archives stay deterministic.
+    """
+
+    def __init__(self, total: int):
+        self.total = int(total)
+        self.delivered = 0
+        self.t_submit = time.perf_counter()
+        self.t_done: Optional[float] = None  # when the LAST result landed
+
+    def _mark_delivered(self, n: int = 1):
+        self.delivered += n
+        if self.done and self.t_done is None:
+            # overlap accounting reads this instead of "now": a handle
+            # may be reconciled long after its last result landed, and
+            # that idle gap is not evaluation time
+            self.t_done = time.perf_counter()
+
+    def poll(self, timeout: Optional[float] = None):
+        raise NotImplementedError
+
+    @property
+    def done(self) -> bool:
+        return self.delivered >= self.total
+
+    def cancel_pending(self) -> int:
+        """Best-effort cancellation of work that has not started; returns
+        the number of requests cancelled. Cancelled requests are counted
+        as delivered and never surface from `poll`."""
+        return 0
+
+    def drain_completed(self):
+        """Teardown helper: every result that has ALREADY landed, as
+        [(index, result)], with NO side effects beyond delivery — in
+        particular no timeout expiry and no retry submission (a retry
+        started during teardown would outlive the driver)."""
+        return []
+
+
+# --------------------------------------------------------- host evaluator
+
+
+class _HostRequest:
+    __slots__ = ("index", "payload", "attempt", "attempts_used", "started_at")
+
+    def __init__(self, index, payload):
+        self.index = index
+        self.payload = payload
+        self.attempt = 0  # live attempt id; stale completions are dropped
+        self.attempts_used = 0
+        self.started_at = None  # set by the worker when execution begins
+
+
+class _HostEvalHandle(AsyncEvalHandle):
+    """Per-request futures over the evaluator's thread pool, with a
+    per-request timeout + retry budget. The timeout clock starts when an
+    attempt begins EXECUTING (queue wait on a narrow pool does not
+    count). A timed-out attempt cannot be killed (Python threads), so it
+    is abandoned: its eventual completion is ignored and a fresh attempt
+    is submitted while the worker slot drains."""
+
+    def __init__(self, evaluator, payloads, timeout, retries):
+        super().__init__(len(payloads))
+        self._ev = evaluator
+        self._timeout = timeout
+        self._retries = int(retries)
+        self._lock = threading.Lock()
+        self._done_q: "queue.Queue" = queue.Queue()
+        self._reqs = [_HostRequest(i, p) for i, p in enumerate(payloads)]
+        self._futures: Dict[int, Any] = {}
+        self._finished = set()
+        # {(index, attempt): ran_on_pool} for attempts presumed hung
+        self._abandoned_attempts: Dict[Tuple[int, int], bool] = {}
+        with self._lock:
+            for req in self._reqs:
+                self._submit_attempt(req)
+
+    def _submit_attempt(self, req: _HostRequest, dedicated: bool = False):
+        """Submit one attempt. Caller holds ``self._lock`` (the lock is
+        not reentrant — this method must never take it itself).
+        ``dedicated`` runs the attempt on its own daemon thread instead
+        of the pool: a timed-out attempt cannot be killed and may occupy
+        its pool slot forever, so its retry must not queue behind it (on
+        a saturated pool the retry would never start, its timeout clock
+        would never tick, and the failure would never be delivered)."""
+        req.started_at = None
+        attempt = req.attempt
+        index = req.index
+        # each abandoned attempt poisons one pool worker; only once ALL
+        # workers are lost does new work escalate to dedicated threads
+        # (a partially healthy pool keeps making progress AND keeps the
+        # n_workers concurrency cap the user asked for)
+        dedicated = dedicated or self._ev._pool_exhausted()
+
+        def run(payload=req.payload, index=index, attempt=attempt):
+            with self._lock:
+                r = self._reqs[index]
+                if r.attempt == attempt:
+                    r.started_at = time.perf_counter()
+            try:
+                out = self._ev.eval_fun(payload)
+                self._done_q.put((index, attempt, out, None))
+            except BaseException as e:
+                self._done_q.put((index, attempt, None, e))
+            finally:
+                # an abandoned (timed-out) attempt returning here proves
+                # its worker was slow, not dead: restore the abandoned
+                # count NOW, on the worker thread itself — the handle
+                # may never be polled again (idempotent with the stale
+                # branches in poll/drain_completed)
+                with self._lock:
+                    if self._reqs[index].attempt != attempt:
+                        self._note_recovered(index, attempt)
+
+        if dedicated:
+            self._futures[index] = None  # a live thread is not cancellable
+            threading.Thread(
+                target=run, daemon=True, name="dmosopt-eval-retry"
+            ).start()
+        else:
+            self._futures[index] = self._ev._ensure_pool().submit(run)
+
+    def _tel_inc(self, name):
+        tel = self._ev.telemetry
+        if tel:
+            tel.inc(name)
+
+    def _note_delivered(self):
+        """Batch-duration accounting once the last result is out — the
+        async path's counterpart of evaluate_batch's histogram."""
+        self._mark_delivered()
+        if self.done:
+            tel = self._ev.telemetry
+            if tel:
+                tel.observe(
+                    "eval_batch_duration_seconds",
+                    time.perf_counter() - self.t_submit,
+                    backend="host",
+                )
+
+    def _retry_or_fail(self, req, error, timed_out):
+        """Timeout/error on the live attempt: resubmit while budget
+        remains, else deliver an EvalFailure. Returns the failure or
+        None (a retry was submitted). Caller holds ``self._lock``."""
+        req.attempts_used += 1
+        req.attempt += 1
+        if timed_out:
+            self._tel_inc("eval_timeouts_total")
+            # the hung attempt is abandoned, not killed. Only a POOL
+            # attempt costs a worker slot (a hung dedicated thread is
+            # its own, already-unbounded casualty), and the evaluator
+            # must know so close() doesn't join the pool forever. If
+            # the attempt later completes after all (merely slow, not
+            # dead), its stale delivery proves the worker survived and
+            # the count is restored in poll/drain
+            on_pool = self._futures.get(req.index) is not None
+            self._abandoned_attempts[(req.index, req.attempt - 1)] = on_pool
+            if on_pool:
+                self._ev._note_abandoned()
+            # and once no healthy worker remains, every queued-but-
+            # unstarted attempt must come OUT of the pool: parked
+            # behind hung workers their timeout clocks would never
+            # start and the handle would poll forever
+            if self._ev._pool_exhausted():
+                self._migrate_queued_to_dedicated()
+        if req.attempts_used <= self._retries:
+            self._tel_inc("eval_retries_total")
+            # the retry goes back to the pool when workers remain
+            # healthy (a queued retry is safe — its timeout clock only
+            # starts at execution — and the n_workers cap holds);
+            # _submit_attempt escalates to a dedicated thread on its
+            # own once the pool is exhausted
+            self._submit_attempt(req)
+            return None
+        self._tel_inc("eval_failures_total")
+        self._finished.add(req.index)
+        self._note_delivered()
+        return EvalFailure(error, req.attempts_used, timed_out=timed_out)
+
+    def _note_recovered(self, index, attempt):
+        """A stale completion arrived for a presumed-hung attempt: the
+        worker survived (slow, not dead) — restore the abandoned count
+        so the pool-exhaustion escalation and close()'s bounded drain
+        stay accurate. Caller holds ``self._lock``."""
+        on_pool = self._abandoned_attempts.pop((index, attempt), None)
+        if on_pool:
+            self._ev._note_worker_recovered()
+
+    def _migrate_queued_to_dedicated(self):
+        """Pull every queued-but-unstarted attempt out of the (now
+        poisoned) pool onto dedicated threads. Caller holds
+        ``self._lock``."""
+        for r in self._reqs:
+            if r.index in self._finished:
+                continue
+            fut = self._futures.get(r.index)
+            if fut is not None and fut.cancel():
+                self._submit_attempt(r, dedicated=True)
+
+    def _expire_overdue(self):
+        """Scan live attempts for per-request timeout violations."""
+        if self._timeout is None:
+            return None
+        now = time.perf_counter()
+        with self._lock:
+            for req in self._reqs:
+                if req.index in self._finished:
+                    continue
+                if (
+                    req.started_at is not None
+                    and now - req.started_at > self._timeout
+                ):
+                    out = self._retry_or_fail(req, None, timed_out=True)
+                    if out is not None:
+                        return req.index, out
+        return None
+
+    def poll(self, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        while not self.done:
+            # drain available completions BEFORE the expiry scan: a
+            # result that arrived within its budget but sat in the
+            # queue while the driver was away (speculative mode spends
+            # whole surrogate fits not polling) must win over a stale
+            # wall-clock expiry
+            try:
+                index, attempt, out, err = self._done_q.get_nowait()
+            except queue.Empty:
+                expired = self._expire_overdue()
+                if expired is not None:
+                    return expired
+                # bounded get so overdue attempts are noticed promptly
+                # even when no completion arrives
+                wait = 0.02 if self._timeout is not None else 5.0
+                if deadline is not None:
+                    wait = min(wait, max(deadline - time.perf_counter(), 0.0))
+                try:
+                    index, attempt, out, err = self._done_q.get(timeout=wait)
+                except queue.Empty:
+                    if deadline is not None and time.perf_counter() >= deadline:
+                        return None
+                    continue
+            with self._lock:
+                req = self._reqs[index]
+                if index in self._finished or attempt != req.attempt:
+                    # stale attempt (abandoned after a timeout); its
+                    # arrival means the worker came back after all
+                    self._note_recovered(index, attempt)
+                    continue
+                if err is None:
+                    self._finished.add(index)
+                    self._note_delivered()
+                    return index, out
+                failure = self._retry_or_fail(req, err, timed_out=False)
+            if failure is not None:
+                return index, failure
+        return None
+
+    def cancel_pending(self) -> int:
+        n = 0
+        with self._lock:
+            for req in self._reqs:
+                if req.index in self._finished:
+                    continue
+                fut = self._futures.get(req.index)
+                if fut is not None and fut.cancel():
+                    req.attempt += 1  # a racing start becomes stale
+                    self._finished.add(req.index)
+                    self._mark_delivered()
+                    n += 1
+        return n
+
+    def drain_completed(self):
+        out = []
+        while True:
+            try:
+                index, attempt, res, err = self._done_q.get_nowait()
+            except queue.Empty:
+                break
+            with self._lock:
+                req = self._reqs[index]
+                if index in self._finished or attempt != req.attempt:
+                    self._note_recovered(index, attempt)
+                    continue  # stale (abandoned) attempt
+                self._finished.add(index)
+                self._mark_delivered()
+            if err is None:
+                out.append((index, res))
+            # attempts that errored are simply dropped at teardown —
+            # no retry may start once the run is ending
+        return out
 
 
 class HostFunEvaluator:
@@ -42,11 +374,39 @@ class HostFunEvaluator:
         self.eval_fun = eval_fun
         self.n_workers = int(n_workers)
         self.telemetry = None  # attached by the driver when enabled
+        # abandoned-worker accounting: mutated from driver AND worker
+        # threads (increment on timeout expiry, decrement when a
+        # presumed-hung worker returns), each possibly holding a
+        # DIFFERENT handle's lock — so it needs its own leaf lock
+        self._n_abandoned = 0
+        self._acct_lock = threading.Lock()
         self._pool = (
             ThreadPoolExecutor(max_workers=self.n_workers)
             if self.n_workers > 1
             else None
         )
+
+    def _note_abandoned(self):
+        with self._acct_lock:
+            self._n_abandoned += 1
+
+    def _note_worker_recovered(self):
+        with self._acct_lock:
+            self._n_abandoned = max(self._n_abandoned - 1, 0)
+
+    def _pool_exhausted(self) -> bool:
+        """True when abandoned (hung) attempts have consumed every pool
+        worker — nothing queued can make progress any more."""
+        with self._acct_lock:
+            return self._n_abandoned >= max(self.n_workers, 1)
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        # async submission always needs a pool; n_workers == 1 runs
+        # evaluate_batch inline but streams submit_batch through one
+        # worker thread (created lazily, torn down by close())
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=max(self.n_workers, 1))
+        return self._pool
 
     def evaluate_batch(
         self, space_vals_list: Sequence[Dict[Any, np.ndarray]]
@@ -66,9 +426,97 @@ class HostFunEvaluator:
             )
         return out
 
-    def close(self):
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
+    def submit_batch(
+        self,
+        space_vals_list: Sequence[Dict[Any, np.ndarray]],
+        timeout: Optional[float] = None,
+        retries: int = 0,
+        **_unused,
+    ) -> AsyncEvalHandle:
+        """Asynchronous evaluation: one pool future per request, results
+        streaming back through the returned handle as they complete.
+        ``timeout`` bounds each attempt's execution seconds; a request
+        is retried up to ``retries`` times after a timeout or an
+        objective exception, then delivered as an `EvalFailure`."""
+        tel = self.telemetry
+        if tel:
+            tel.inc("eval_batches_total", backend="host")
+        return _HostEvalHandle(self, list(space_vals_list), timeout, retries)
+
+    def close(self, drain_timeout: float = 30.0):
+        if self._pool is None:
+            return
+        pool, self._pool = self._pool, None
+        # drain, don't abandon: in-flight objective calls may hold file
+        # handles or subprocesses that must not outlive the driver (they
+        # raced HDF5 teardown when this was shutdown(wait=False));
+        # queued-but-unstarted calls are cancelled. The drain runs on a
+        # helper thread with a bounded join: an objective call that
+        # never returns (wedged, or hung with no eval_timeout
+        # configured) must not hang teardown forever — whatever is
+        # still running after `drain_timeout` is daemonic and cannot
+        # block process exit
+        t = threading.Thread(
+            target=lambda: pool.shutdown(wait=True, cancel_futures=True),
+            daemon=True, name="dmosopt-eval-drain",
+        )
+        t.start()
+        t.join(drain_timeout)
+
+
+# ---------------------------------------------------------- jax evaluator
+
+
+class _JaxEvalHandle(AsyncEvalHandle):
+    """Device-chunk streaming: every chunk was dispatched (asynchronously,
+    no ``block_until_ready``) at submit time, so the device pipeline
+    works through them back-to-back while the host drains finished
+    chunks in dispatch order — chunk k transfers to host while chunk
+    k+1 executes."""
+
+    def __init__(self, total: int, chunks: List[Tuple[List[int], Callable, Callable]]):
+        super().__init__(total)
+        # [(round indices, finalize closure, device-readiness probe)]
+        self._chunks = list(chunks)
+        self._buffer: List[Tuple[int, Dict]] = []
+
+    def poll(self, timeout: Optional[float] = None):
+        if self._buffer:
+            idx, res = self._buffer.pop(0)
+            self._mark_delivered()
+            return idx, res
+        if not self._chunks:
+            return None
+        indices, finalize, ready = self._chunks[0]
+        if timeout is not None:
+            # honor the handle contract: return None when the chunk is
+            # still executing at the deadline, so a polling caller can
+            # re-check its own stop conditions (the device work itself
+            # cannot be interrupted, only not-waited-for)
+            deadline = time.monotonic() + timeout
+            while not ready():
+                if time.monotonic() >= deadline:
+                    return None
+                time.sleep(0.005)
+        self._chunks.pop(0)
+        results = finalize()  # blocks until this chunk's arrays land
+        self._buffer = list(zip(indices, results))
+        idx, res = self._buffer.pop(0)
+        self._mark_delivered()
+        return idx, res
+
+    def cancel_pending(self) -> int:
+        n = sum(len(ix) for ix, _, _ in self._chunks) + len(self._buffer)
+        self._chunks = []
+        self._buffer = []
+        self._mark_delivered(n)
+        return n
+
+    def drain_completed(self):
+        out = []
+        while self._buffer or (self._chunks and self._chunks[0][2]()):
+            out.append(self.poll())  # prompt: the chunk is device-ready
+        return out
 
 
 class JaxBatchEvaluator:
@@ -123,9 +571,15 @@ class JaxBatchEvaluator:
             o = multihost_utils.process_allgather(o, tiled=True)
         return np.asarray(o)
 
-    def _call(self, X: np.ndarray):
+    def _dispatch(self, X: np.ndarray, pad_to: Optional[int] = None):
+        """Pad and launch the jitted call WITHOUT blocking; returns the
+        (device-resident, possibly still executing) output tuple plus
+        the unpadded row count. ``pad_to`` forces a common batch shape so
+        chunked submission compiles one program, not one per chunk."""
         B = X.shape[0]
-        pad = (-B) % self._n_shards
+        target = B if pad_to is None else max(pad_to, B)
+        target += (-target) % self._n_shards
+        pad = target - B
         if pad:
             X = np.concatenate([X, np.repeat(X[-1:], pad, axis=0)], axis=0)
         tel = self.telemetry
@@ -134,35 +588,54 @@ class JaxBatchEvaluator:
             # counter attributes the dispatch-time spike below to it
             self._seen_shapes.add(X.shape)
             tel.inc("eval_batch_compiles_total")
-        t0 = time.perf_counter()
         out = self._fn(jnp.asarray(X, jnp.float32))
+        if not isinstance(out, tuple):
+            out = (out,)
+        return out, B
+
+    def _call(self, X: np.ndarray):
+        tel = self.telemetry
+        t0 = time.perf_counter()
+        out, B = self._dispatch(X)
         if tel:
             t1 = time.perf_counter()  # async dispatch returned
             jax.block_until_ready(out)
             t2 = time.perf_counter()  # device execution drained
             tel.observe("eval_dispatch_seconds", t1 - t0)
             tel.observe("eval_execute_seconds", t2 - t1)
-        if not isinstance(out, tuple):
-            out = (out,)
         return tuple(self._to_host(o)[:B] for o in out)
+
+    def _rounds_to_results(self, rounds, outs_by_problem):
+        """Assemble worker-protocol result dicts for `rounds` from the
+        per-problem host output tuples in `outs_by_problem`."""
+        results: List[Dict] = [dict() for _ in rounds]
+        for problem_id, (idx, outs) in outs_by_problem.items():
+            for j, i in enumerate(idx):
+                row = tuple(o[j] for o in outs)
+                results[i][problem_id] = row[0] if len(row) == 1 else row
+        return results
+
+    def _stack_problems(self, rounds):
+        """{problem_id: (round positions, stacked X)} over `rounds` —
+        entries may cover a subset of problems (unequal queue lengths)."""
+        stacked = {}
+        for problem_id in self.problem_ids:
+            idx = [i for i, sv in enumerate(rounds) if problem_id in sv]
+            if idx:
+                stacked[problem_id] = (
+                    idx, np.stack([rounds[i][problem_id] for i in idx])
+                )
+        return stacked
 
     def evaluate_batch(
         self, space_vals_list: Sequence[Dict[Any, np.ndarray]]
     ) -> List[Dict]:
-        results: List[Dict] = [dict() for _ in space_vals_list]
         t0 = time.time()
-        for problem_id in self.problem_ids:
-            # entries may cover a subset of problems (unequal queue lengths)
-            idx = [
-                i for i, sv in enumerate(space_vals_list) if problem_id in sv
-            ]
-            if not idx:
-                continue
-            X = np.stack([space_vals_list[i][problem_id] for i in idx])
-            outs = self._call(X)
-            for j, i in enumerate(idx):
-                row = tuple(o[j] for o in outs)
-                results[i][problem_id] = row[0] if len(row) == 1 else row
+        outs_by_problem = {
+            pid: (idx, self._call(X))
+            for pid, (idx, X) in self._stack_problems(space_vals_list).items()
+        }
+        results = self._rounds_to_results(space_vals_list, outs_by_problem)
         dt = (time.time() - t0) / max(len(space_vals_list), 1)
         for r in results:
             r["time"] = dt
@@ -173,6 +646,79 @@ class JaxBatchEvaluator:
                 "eval_batch_duration_seconds", time.time() - t0, backend="jax"
             )
         return results
+
+    def submit_batch(
+        self,
+        space_vals_list: Sequence[Dict[Any, np.ndarray]],
+        n_chunks: int = 1,
+        **_unused,
+    ) -> AsyncEvalHandle:
+        """Asynchronous evaluation: the batch splits into up to
+        ``n_chunks`` equally-shaped device chunks, ALL dispatched
+        immediately (jax dispatch is asynchronous — nothing here blocks
+        on device execution), and the handle streams each chunk's
+        results back in dispatch order as the device finishes them.
+        Per-request timeout/retry does not apply to this backend (a
+        jitted call either completes or the run is lost)."""
+        rounds = list(space_vals_list)
+        B = len(rounds)
+        tel = self.telemetry
+        if tel:
+            tel.inc("eval_batches_total", backend="jax")
+        n_chunks = max(1, min(int(n_chunks), B)) if B else 1
+        # equal shapes: one compiled program (min 1 so an empty batch
+        # yields an already-done handle instead of a zero range step)
+        chunk_len = max(-(-B // n_chunks), 1)
+        t_submit = time.time()
+        t_disp0 = time.perf_counter()
+        chunks = []
+        last_start = (B - 1) // chunk_len * chunk_len if B else 0
+        for start in range(0, B, chunk_len):
+            part = rounds[start : start + chunk_len]
+            dispatched = {
+                pid: (idx, self._dispatch(X, pad_to=chunk_len))
+                for pid, (idx, X) in self._stack_problems(part).items()
+            }
+
+            def finalize(part=part, dispatched=dispatched, last=start == last_start):
+                t0 = time.perf_counter()
+                outs_by_problem = {
+                    pid: (idx, tuple(self._to_host(o)[:nb] for o in out))
+                    for pid, (idx, (out, nb)) in dispatched.items()
+                }
+                results = self._rounds_to_results(part, outs_by_problem)
+                dt = (time.time() - t_submit) / max(B, 1)
+                for r in results:
+                    r["time"] = dt
+                if tel:
+                    # per-chunk drain wait; on the last chunk also the
+                    # whole batch's submit->land duration — the async
+                    # counterparts of _call's execute/batch histograms
+                    tel.observe("eval_execute_seconds", time.perf_counter() - t0)
+                    if last:
+                        tel.observe(
+                            "eval_batch_duration_seconds",
+                            time.time() - t_submit,
+                            backend="jax",
+                        )
+                return results
+
+            def ready(dispatched=dispatched):
+                # non-blocking device-completion probe (older jax
+                # without Array.is_ready conservatively reports ready
+                # and poll falls back to blocking in finalize)
+                for _, (out, _nb) in dispatched.values():
+                    for o in out:
+                        if hasattr(o, "is_ready") and not o.is_ready():
+                            return False
+                return True
+
+            chunks.append(
+                (list(range(start, start + len(part))), finalize, ready)
+            )
+        if tel and B:
+            tel.observe("eval_dispatch_seconds", time.perf_counter() - t_disp0)
+        return _JaxEvalHandle(B, chunks)
 
     def close(self):
         pass

@@ -1,0 +1,202 @@
+"""Epoch-pipeline plumbing: pipeline configuration and the background
+persistence writer.
+
+The reference hides real-objective latency behind distwq's asynchronous
+task queue (reference: dmosopt/dmosopt.py:1152-1339 — submit_multiple /
+probe_all_next_results polling). Our single-process epoch loop gets the
+same overlap from two smaller pieces:
+
+- `PipelineConfig`: the driver's ``pipeline`` knob, deciding how much of
+  the epoch overlaps — ``serial`` (the fully synchronous legacy loop),
+  ``overlap_io`` (the default: HDF5 appends and telemetry summaries run
+  on a background writer thread, evaluation results stream back
+  as-completed but are folded in submission order, so archives stay
+  byte-identical to serial), and ``speculative`` (additionally start the
+  next epoch's surrogate fit once a quorum fraction of the resample
+  batch has landed; stragglers reconcile into the following training
+  set).
+- `BackgroundWriter`: a single-thread ordered executor for persistence
+  closures. One thread + submission-order execution means the HDF5 file
+  sees exactly the write sequence the serial loop would issue — the
+  overlap changes *when* the driver blocks, never *what* is written.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Optional, Union
+
+#: pipeline modes, in increasing order of overlap
+PIPELINE_MODES = ("serial", "overlap_io", "speculative")
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """Resolved form of the driver's ``pipeline`` parameter.
+
+    mode: one of `PIPELINE_MODES`.
+    quorum_fraction: in ``speculative`` mode, the fraction of a drain's
+        evaluation rounds that must complete (in submission order)
+        before the epoch proceeds to the surrogate fit; the remainder
+        keep evaluating in flight and are reconciled at the next drain.
+    eval_timeout: per-request wall-clock budget in seconds for host
+        objectives (None = wait forever). A request that exceeds it is
+        retried (`eval_retries` times) and then marked failed.
+    eval_retries: resubmissions allowed per request after a timeout or
+        an objective exception.
+    on_eval_failure: ``"raise"`` (default — a request that fails after
+        all retries aborts the run, matching the serial loop) or
+        ``"skip"`` (mark only that request failed; the batch survives).
+    jax_eval_chunks: number of equally-shaped device chunks a
+        `JaxBatchEvaluator` batch is split into so results stream back
+        per chunk instead of per whole batch (1 = no chunking).
+    """
+
+    mode: str = "overlap_io"
+    quorum_fraction: float = 0.6
+    eval_timeout: Optional[float] = None
+    eval_retries: int = 0
+    on_eval_failure: str = "raise"
+    jax_eval_chunks: int = 1
+
+    def __post_init__(self):
+        if self.mode not in PIPELINE_MODES:
+            raise ValueError(
+                f"pipeline mode {self.mode!r} not in {PIPELINE_MODES}"
+            )
+        if not (0.0 < self.quorum_fraction <= 1.0):
+            raise ValueError(
+                f"quorum_fraction must be in (0, 1]; got {self.quorum_fraction}"
+            )
+        if self.on_eval_failure not in ("raise", "skip"):
+            raise ValueError(
+                f"on_eval_failure must be 'raise' or 'skip'; "
+                f"got {self.on_eval_failure!r}"
+            )
+        if self.jax_eval_chunks < 1:
+            raise ValueError("jax_eval_chunks must be >= 1")
+
+    @property
+    def overlaps_io(self) -> bool:
+        return self.mode != "serial"
+
+    @property
+    def speculative(self) -> bool:
+        return self.mode == "speculative"
+
+    @classmethod
+    def from_spec(
+        cls, spec: Union[None, str, dict, "PipelineConfig"]
+    ) -> "PipelineConfig":
+        """Resolve the driver's ``pipeline`` value: None -> the default
+        (overlap_io), a mode string, a dict of constructor kwargs (with
+        the mode under ``"mode"``), or a ready-made config."""
+        if spec is None:
+            return cls()
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, str):
+            return cls(mode=spec)
+        if isinstance(spec, dict):
+            return cls(**spec)
+        raise TypeError(
+            f"pipeline must be None, str, dict, or PipelineConfig; "
+            f"got {type(spec)!r}"
+        )
+
+
+class BackgroundWriter:
+    """Ordered single-thread executor for persistence closures.
+
+    Semantics are exact by construction: one worker thread executes
+    submitted closures strictly in submission order, so the HDF5 file
+    goes through the identical sequence of states the serial loop would
+    produce. `flush()` blocks until everything submitted so far has
+    executed — the driver calls it before any state a restart could
+    observe (end of each epoch, run teardown).
+
+    Errors: a closure that raises kills the writer — the exception is
+    re-raised (wrapped) from the next `submit`/`flush`/`close` call on
+    the driver thread, every subsequent closure is skipped, and the
+    writer refuses new submissions from then on, so a failed append can
+    never be followed by later writes (an archive with a silent gap is
+    worse than a dead run).
+    """
+
+    def __init__(self, name: str = "dmosopt-writer", telemetry=None):
+        self.telemetry = telemetry
+        self._q: "queue.Queue" = queue.Queue()
+        self._error: Optional[BaseException] = None
+        self._failed = False  # error already surfaced; writer is dead
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ worker
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                fn, args, kwargs = item
+                if self._error is None and not self._failed:
+                    try:
+                        fn(*args, **kwargs)
+                    except BaseException as e:  # surfaced on driver thread
+                        self._error = e
+            finally:
+                self._q.task_done()
+
+    # ------------------------------------------------------------ driver
+
+    def _raise_pending(self):
+        if self._error is not None:
+            # _failed goes up BEFORE _error comes down: the worker
+            # checks `_error is None and not _failed`, and a window
+            # with both clear would let a queued write slip through
+            # after the failure
+            self._failed = True
+            err, self._error = self._error, None
+            raise RuntimeError("background persistence write failed") from err
+        if self._failed:
+            raise RuntimeError(
+                "background persistence writer is dead after an earlier "
+                "write failure"
+            )
+
+    @property
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        if self._closed:
+            raise RuntimeError("BackgroundWriter is closed")
+        self._raise_pending()
+        self._q.put((fn, args, kwargs))
+        if self.telemetry:
+            self.telemetry.gauge("writer_queue_depth", self._q.qsize())
+
+    def flush(self) -> None:
+        """Block until every closure submitted so far has executed;
+        re-raise the first deferred write error."""
+        self._q.join()
+        if self.telemetry:
+            self.telemetry.gauge("writer_queue_depth", 0)
+        self._raise_pending()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._q.join()
+        self._closed = True
+        self._q.put(None)
+        self._thread.join()
+        # only raise an error nobody has seen yet: run() closes the
+        # writer inside its finally block, and re-raising an already
+        # surfaced failure there would mask the original exception
+        if self._error is not None:
+            self._raise_pending()

@@ -16,6 +16,7 @@ per-generation request/complete cycle matches the reference exactly.
 from __future__ import annotations
 
 import itertools
+from collections import deque
 from collections.abc import Iterator, Sequence
 from typing import Dict, Optional, Union
 
@@ -138,7 +139,7 @@ class DistOptStrategy:
             )
             if xinit is not None:
                 ph["n_points"] = int(xinit.shape[0])
-        self.reqs = []
+        self.reqs = deque()
         if xinit is not None:
             if xinit.shape[1] != prob.dim:
                 raise ValueError(
@@ -146,7 +147,7 @@ class DistOptStrategy:
                 )
             seeded = (EvalRequest(row, None, 0) for row in xinit)
             self.reqs = (
-                list(seeded)
+                deque(seeded)
                 if initial is None
                 else filter(
                     lambda req: not anyclose(req.parameters, self.x), seeded
@@ -174,7 +175,7 @@ class DistOptStrategy:
 
     def append_request(self, req: EvalRequest):
         if isinstance(self.reqs, Iterator):
-            self.reqs = list(self.reqs)
+            self.reqs = deque(self.reqs)
         self.reqs.append(req)
 
     def has_requests(self) -> bool:
@@ -194,7 +195,9 @@ class DistOptStrategy:
             except StopIteration:
                 return None
         if self.reqs:
-            return self.reqs.pop(0)
+            # deque popleft: O(1) per request — a 40k-row generation
+            # drained one request at a time was quadratic as a list
+            return self.reqs.popleft()
         return None
 
     def complete_request(

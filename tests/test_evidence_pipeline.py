@@ -114,6 +114,39 @@ def test_dryrun_multichip_wall_clock_budget():
 
 
 @pytest.mark.slow
+def test_dryrun_multichip_env_forced_parent_stays_jax_free(tmp_path):
+    """The round-5 red gate, pinned by construction: under the exact
+    axon-style driver env (`JAX_PLATFORMS=cpu` + 8 forced virtual
+    devices) the dryrun parent must never import jax — a poisoned `jax`
+    package sits on the parent's PYTHONPATH and raises on import. The
+    poison dir's basename contains 'axon', so `axon_free_pythonpath`
+    strips it from the respawned child, which gets the real jax and must
+    complete the full dryrun."""
+    site = tmp_path / "fakeaxon_jaxpoison"
+    (site / "jax").mkdir(parents=True)
+    (site / "jax" / "__init__.py").write_text(
+        "raise RuntimeError('BACKEND TOUCHED: jax imported in the "
+        "dryrun parent')\n"
+    )
+    env = _clean_env(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    env["PYTHONPATH"] = str(site) + os.pathsep + REPO
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420,
+    )
+    tail = proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert proc.returncode == 0, tail
+    assert "BACKEND TOUCHED" not in tail
+    # the work ran in the delegated child, on the env's 8-device CPU mesh
+    assert "VIRTUAL CPU mesh" in proc.stdout, tail
+    assert "sharded batch evaluator OK" in proc.stdout, tail
+
+
+@pytest.mark.slow
 def test_dryrun_multichip_survives_wedged_probe(tmp_path):
     """The driver-real failure mode that cost rounds 3 AND 4: no
     JAX_PLATFORMS short-circuit, so dryrun_multichip pays the real

@@ -118,6 +118,47 @@ def test_rank_masked(rng):
     assert (got[17:] == 30).all()
 
 
+def test_biobjective_sweep_matches_matrix_peel(rng):
+    """The d == 2 O(N log N) sweep must be BITWISE identical to the
+    general matrix peel — duplicates, shared single coordinates, NaN
+    rows, infinities, and masks included — so every bi-objective
+    optimizer trajectory is unchanged by the routing."""
+    from dmosopt_tpu.ops.dominance import _rank_matrix_peel
+
+    for trial in range(25):
+        n = int(rng.integers(3, 120))
+        Y = rng.random((n, 2)).astype(np.float32)
+        if n > 10:
+            Y[rng.integers(0, n, 5)] = Y[rng.integers(0, n, 5)]  # dup rows
+            Y[rng.integers(0, n, 5), 0] = Y[rng.integers(0, n, 5), 0]  # ties
+        if trial % 5 == 1:
+            Y[rng.integers(0, n, max(1, n // 8)), 1] = np.nan
+        if trial % 7 == 2:
+            Y[rng.integers(0, n, max(1, n // 8)), 0] = np.inf
+        mask = None
+        if trial % 3 == 0:
+            mask = jnp.asarray(rng.random(n) > 0.3)
+        ref = np.asarray(_rank_matrix_peel(jnp.asarray(Y), mask=mask))
+        got = np.asarray(non_dominated_rank(jnp.asarray(Y), mask=mask))
+        np.testing.assert_array_equal(got, ref, err_msg=f"trial {trial}")
+
+
+def test_biobjective_sweep_stop_count_refinement(rng):
+    """With stop_count the sweep returns exact ranks beyond the cut
+    (instead of the matrix path's n-1 sentinel) — every rank within the
+    peeled fronts must still agree exactly, and beyond-cut ranks must
+    order strictly after them (the property survival slicing relies on)."""
+    from dmosopt_tpu.ops.dominance import _rank_matrix_peel
+
+    Y = jnp.asarray(rng.random((60, 2)).astype(np.float32))
+    ref = np.asarray(_rank_matrix_peel(Y, stop_count=20))
+    got = np.asarray(non_dominated_rank(Y, stop_count=20))
+    peeled = ref < 59  # matrix path: unpeeled rows carry the n-1 sentinel
+    np.testing.assert_array_equal(got[peeled], ref[peeled])
+    if (~peeled).any():
+        assert got[~peeled].min() > ref[peeled].max()
+
+
 @pytest.mark.parametrize("n,d", [(2, 2), (17, 2), (40, 4)])
 def test_crowding_matches_naive(n, d, rng):
     Y = rng.random((n, d))

@@ -1,0 +1,572 @@
+"""Overlapped epoch pipeline: async evaluation streaming, background
+persistence, quorum/speculative semantics, and the failure policies.
+
+The contract under test (docs/parallel.md "Overlapped epoch pipeline"):
+``overlap_io`` (the default) may only change WHEN the driver blocks —
+archives are byte-identical to ``serial`` on a seeded run; result
+arrival order never leaks into archive row order; a request that raises
+or times out kills only itself; ``speculative`` returns at quorum and
+reconciles stragglers into the next training set.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dmosopt_tpu
+from dmosopt_tpu.parallel.evaluator import (
+    EvalFailure,
+    HostFunEvaluator,
+    JaxBatchEvaluator,
+)
+from dmosopt_tpu.parallel.pipeline import BackgroundWriter, PipelineConfig
+from dmosopt_tpu.telemetry import Telemetry
+
+N_DIM = 4
+
+
+def zdt1_host(pp):
+    x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+    f1 = x[0]
+    g = 1.0 + 9.0 / (N_DIM - 1) * np.sum(x[1:])
+    return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
+
+
+def _params(**over):
+    params = {
+        "opt_id": "test_pipeline",
+        "obj_fun": zdt1_host,
+        "objective_names": ["f1", "f2"],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(N_DIM)},
+        "problem_parameters": {},
+        "n_initial": 4,
+        "n_epochs": 2,
+        "population_size": 16,
+        "num_generations": 5,
+        "resample_fraction": 0.5,
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 20, "seed": 0},
+        "random_seed": 7,
+        "telemetry": False,
+    }
+    params.update(over)
+    return params
+
+
+# ------------------------------------------------------- PipelineConfig
+
+
+def test_pipeline_config_from_spec():
+    assert PipelineConfig.from_spec(None).mode == "overlap_io"
+    assert PipelineConfig.from_spec("serial").mode == "serial"
+    cfg = PipelineConfig.from_spec(
+        {"mode": "speculative", "quorum_fraction": 0.5, "eval_retries": 2}
+    )
+    assert cfg.speculative and cfg.quorum_fraction == 0.5
+    assert PipelineConfig.from_spec(cfg) is cfg
+    assert not PipelineConfig.from_spec("serial").overlaps_io
+    with pytest.raises(ValueError):
+        PipelineConfig(mode="warp")
+    with pytest.raises(ValueError):
+        PipelineConfig(quorum_fraction=0.0)
+    with pytest.raises(ValueError):
+        PipelineConfig(on_eval_failure="shrug")
+    with pytest.raises(TypeError):
+        PipelineConfig.from_spec(3)
+
+
+# ----------------------------------------------------- BackgroundWriter
+
+
+def test_background_writer_executes_in_submission_order():
+    seen = []
+    w = BackgroundWriter()
+    for i in range(50):
+        w.submit(lambda i=i: (time.sleep(0.001 if i % 7 == 0 else 0), seen.append(i)))
+    w.flush()
+    assert seen == list(range(50))
+    w.close()
+
+
+def test_background_writer_surfaces_errors_and_skips_rest():
+    seen = []
+    w = BackgroundWriter()
+    w.submit(seen.append, 1)
+
+    def boom():
+        raise OSError("disk gone")
+
+    w.submit(boom)
+    w.submit(seen.append, 2)  # must be skipped after the failure
+    with pytest.raises(RuntimeError, match="background persistence"):
+        w.flush()
+    assert seen == [1]
+    # the failure is terminal: new submissions are refused and never
+    # execute — a failed append can never be followed by later writes
+    with pytest.raises(RuntimeError, match="dead"):
+        w.submit(seen.append, 3)
+    w.close()
+    assert seen == [1]
+
+
+def test_background_writer_close_is_idempotent_and_final():
+    w = BackgroundWriter()
+    w.submit(lambda: None)
+    w.close()
+    w.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit(lambda: None)
+
+
+# ----------------------------------------------- HostFunEvaluator async
+
+
+def test_host_submit_batch_streams_as_completed():
+    """Requests finish out of submission order (reversed sleeps); the
+    handle must deliver them in completion order with correct indices
+    and results."""
+
+    def obj(sv):
+        i = int(sv["i"])
+        time.sleep(0.02 * (4 - i))
+        return {0: np.array([float(i)]), "time": 0.0}
+
+    ev = HostFunEvaluator(obj, n_workers=4)
+    try:
+        h = ev.submit_batch([{"i": np.array(i)} for i in range(4)])
+        got = []
+        while not h.done:
+            item = h.poll(timeout=5.0)
+            assert item is not None
+            got.append(item)
+        order = [i for i, _ in got]
+        assert sorted(order) == [0, 1, 2, 3]
+        assert order != [0, 1, 2, 3]  # genuinely completion-ordered
+        for i, res in got:
+            assert res[0][0] == float(i)
+    finally:
+        ev.close()
+
+
+def test_host_submit_batch_failure_isolated_to_request():
+    def obj(sv):
+        if int(sv["i"]) == 1:
+            raise ValueError("bad point")
+        return {0: np.array([1.0]), "time": 0.0}
+
+    ev = HostFunEvaluator(obj, n_workers=2)
+    try:
+        h = ev.submit_batch([{"i": np.array(i)} for i in range(3)])
+        results = {}
+        while not h.done:
+            i, res = h.poll(timeout=5.0)
+            results[i] = res
+        assert isinstance(results[1], EvalFailure)
+        assert isinstance(results[1].error, ValueError)
+        assert not results[1].timed_out
+        assert results[0][0][0] == 1.0 and results[2][0][0] == 1.0
+    finally:
+        ev.close()
+
+
+def test_host_submit_batch_timeout_retry_giveup_telemetry():
+    """A hung objective: timeout -> retry -> give-up, the whole path
+    recorded in telemetry counters."""
+    calls = []
+
+    def obj(sv):
+        calls.append(1)
+        time.sleep(10.0)
+
+    tel = Telemetry()
+    ev = HostFunEvaluator(obj, n_workers=2)
+    ev.telemetry = tel
+    try:
+        h = ev.submit_batch([{"i": np.array(0)}], timeout=0.1, retries=1)
+        i, res = h.poll(timeout=30.0)
+        assert i == 0
+        assert isinstance(res, EvalFailure)
+        assert res.timed_out and res.n_attempts == 2
+        r = tel.registry
+        assert r.counter_value("eval_timeouts_total") == 2
+        assert r.counter_value("eval_retries_total") == 1
+        assert r.counter_value("eval_failures_total") == 1
+    finally:
+        ev.close()
+
+
+def test_host_close_drains_inflight_calls():
+    """Satellite pin: close() must wait for running objective calls
+    (they used to outlive the driver and race HDF5 teardown under
+    shutdown(wait=False)) and cancel queued-but-unstarted ones."""
+    started = threading.Event()
+    finished = threading.Event()
+
+    def obj(sv):
+        if int(sv["i"]) == 0:
+            started.set()
+            time.sleep(0.3)
+            finished.set()
+        return {0: np.array([0.0]), "time": 0.0}
+
+    ev = HostFunEvaluator(obj, n_workers=1)
+    h = ev.submit_batch([{"i": np.array(i)} for i in range(5)])
+    assert started.wait(5.0)
+    ev.close()
+    # the in-flight call ran to completion BEFORE close returned
+    assert finished.is_set()
+    # the queued requests never started; they are cancellable afterwards
+    assert h.cancel_pending() >= 0
+
+
+def test_host_retry_not_starved_by_saturated_pool():
+    """A hung objective on a 1-worker pool: the abandoned attempt holds
+    the only worker forever, so the retry must run on a dedicated thread
+    — its timeout clock ticks and the EvalFailure is delivered in
+    bounded time instead of the handle polling forever."""
+
+    def obj(sv):
+        time.sleep(60.0)
+
+    ev = HostFunEvaluator(obj, n_workers=1)
+    try:
+        h = ev.submit_batch([{"i": np.array(0)}], timeout=0.1, retries=1)
+        t0 = time.perf_counter()
+        i, res = h.poll(timeout=30.0)
+        assert time.perf_counter() - t0 < 10.0
+        assert isinstance(res, EvalFailure)
+        assert res.timed_out and res.n_attempts == 2
+    finally:
+        ev.close()
+
+
+def test_host_hung_worker_does_not_starve_queued_requests():
+    """One hung objective on a 1-worker pool must not strand the
+    requests queued behind it: after the hang is detected they migrate
+    to dedicated threads, so the batch completes — one EvalFailure, the
+    rest real results — in bounded time."""
+
+    def obj(sv):
+        if int(sv["i"]) == 0:
+            time.sleep(60.0)
+        return {0: np.array([float(sv["i"])]), "time": 0.0}
+
+    ev = HostFunEvaluator(obj, n_workers=1)
+    try:
+        h = ev.submit_batch(
+            [{"i": np.array(i)} for i in range(3)], timeout=0.2, retries=0
+        )
+        t0 = time.perf_counter()
+        results = {}
+        while not h.done:
+            item = h.poll(timeout=30.0)
+            assert item is not None
+            results[item[0]] = item[1]
+        assert time.perf_counter() - t0 < 15.0
+        assert isinstance(results[0], EvalFailure) and results[0].timed_out
+        assert results[1][0][0] == 1.0 and results[2][0][0] == 2.0
+    finally:
+        ev.close(drain_timeout=1.0)
+
+
+def test_submit_batch_empty_is_done_handle():
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+
+    ev = HostFunEvaluator(lambda sv: {0: np.zeros(2), "time": 0.0})
+    h = ev.submit_batch([])
+    assert h.done and h.poll(timeout=0.01) is None
+    ev.close()
+    jev = JaxBatchEvaluator(zdt1, problem_ids=[0])
+    h = jev.submit_batch([])
+    assert h.done and h.poll(timeout=0.01) is None
+
+
+def test_host_queued_completion_beats_stale_expiry():
+    """Speculative mode can go a whole surrogate fit without polling. A
+    result that completed WITHIN its timeout budget but sat in the
+    completion queue during that gap must be delivered, not expired by
+    its stale wall-clock reading."""
+
+    def obj(sv):
+        return {0: np.array([7.0]), "time": 0.0}
+
+    ev = HostFunEvaluator(obj, n_workers=1)
+    try:
+        h = ev.submit_batch([{"i": np.array(0)}], timeout=0.2, retries=0)
+        time.sleep(0.8)  # result completed instantly; driver was away
+        i, res = h.poll(timeout=5.0)
+        assert not isinstance(res, EvalFailure), res
+        assert res[0][0] == 7.0
+    finally:
+        ev.close()
+
+
+def test_host_close_prompt_after_abandoned_timeout():
+    """close() drains normal in-flight calls, but must NOT join a
+    worker stuck in a timed-out (abandoned, un-killable) objective —
+    teardown would hang for the exact hung-objective case the timeout
+    policy exists to survive."""
+
+    def obj(sv):
+        time.sleep(60.0)
+
+    ev = HostFunEvaluator(obj, n_workers=1)
+    h = ev.submit_batch([{"i": np.array(0)}], timeout=0.1, retries=0)
+    i, res = h.poll(timeout=30.0)
+    assert isinstance(res, EvalFailure) and res.timed_out
+    t0 = time.perf_counter()
+    ev.close(drain_timeout=1.0)
+    assert time.perf_counter() - t0 < 5.0
+
+
+# ----------------------------------------------- JaxBatchEvaluator async
+
+
+def test_jax_submit_batch_chunked_matches_blocking():
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+
+    ev = JaxBatchEvaluator(zdt1, problem_ids=[0])
+    rng = np.random.default_rng(0)
+    reqs = [{0: rng.random(6).astype(np.float32)} for _ in range(7)]
+    blocking = ev.evaluate_batch(reqs)
+    h = ev.submit_batch(reqs, n_chunks=3)
+    streamed = {}
+    while not h.done:
+        i, res = h.poll()
+        streamed[i] = res
+    assert sorted(streamed) == list(range(7))
+    for i in range(7):
+        np.testing.assert_allclose(streamed[i][0], blocking[i][0], rtol=1e-6)
+
+
+def test_jax_handle_poll_honors_timeout():
+    """The AsyncEvalHandle contract: poll(timeout) returns None while
+    the chunk is still executing, the result once it lands (driven with
+    synthetic chunks — device execution itself is not interruptible)."""
+    from dmosopt_tpu.parallel.evaluator import _JaxEvalHandle
+
+    state = {"ready": False}
+    r0, r1 = {0: np.array([1.0])}, {0: np.array([2.0])}
+    h = _JaxEvalHandle(
+        2, [([0, 1], lambda: [r0, r1], lambda: state["ready"])]
+    )
+    t0 = time.perf_counter()
+    assert h.poll(timeout=0.05) is None
+    assert 0.04 < time.perf_counter() - t0 < 2.0
+    state["ready"] = True
+    assert h.poll(timeout=5.0) == (0, r0)
+    assert h.poll() == (1, r1)
+    assert h.done
+
+
+# ------------------------------------------------------- driver-level
+
+
+def _archive(opt_id="test_pipeline"):
+    from dmosopt_tpu.driver import dopt_dict
+
+    strat = dopt_dict[opt_id].optimizer_dict[0]
+    return np.asarray(strat.x), np.asarray(strat.y)
+
+
+def test_out_of_order_arrival_preserves_archive_row_order():
+    """4 workers + parameter-dependent sleeps scramble completion order;
+    the overlap_io archive must equal the serial archive row for row."""
+
+    def sleepy(pp):
+        y = zdt1_host(pp)
+        time.sleep(0.01 * (1.0 - float(pp["x0"])))  # later rows finish first
+        return y
+
+    dmosopt_tpu.run(
+        _params(opt_id="ooo_serial", obj_fun=sleepy, pipeline="serial"),
+        verbose=False,
+    )
+    xs, ys = _archive("ooo_serial")
+    dmosopt_tpu.run(
+        _params(
+            opt_id="ooo_overlap", obj_fun=sleepy, pipeline="overlap_io",
+            n_eval_workers=4,
+        ),
+        verbose=False,
+    )
+    xo, yo = _archive("ooo_overlap")
+    np.testing.assert_array_equal(xs, xo)
+    np.testing.assert_array_equal(ys, yo)
+
+
+def test_overlap_io_archive_byte_identical_to_serial(tmp_path, monkeypatch):
+    """Acceptance pin: on a seeded run, pipeline="overlap_io" produces a
+    byte-identical HDF5 archive to serial mode. Wall-clock readings are
+    the one legitimately nondeterministic archive input (eval-time stats
+    differ even between two serial runs), so the clock is frozen — what
+    remains is exactly the write-sequence determinism the overlap mode
+    guarantees."""
+    monkeypatch.setattr(time, "time", lambda: 0.0)
+    monkeypatch.setattr(time, "perf_counter", lambda: 0.0)
+    blobs = {}
+    for mode in ("serial", "overlap_io", "serial_again"):
+        fp = tmp_path / f"{mode}.h5"
+        dmosopt_tpu.run(
+            _params(
+                opt_id="bytes", file_path=str(fp), save=True, save_eval=5,
+                pipeline="serial" if mode == "serial_again" else mode,
+            ),
+            verbose=False,
+        )
+        blobs[mode] = fp.read_bytes()
+    # control: the harness itself is deterministic across serial runs
+    assert blobs["serial"] == blobs["serial_again"]
+    assert blobs["overlap_io"] == blobs["serial"]
+
+
+def test_speculative_quorum_reconciles_stragglers():
+    """Speculative mode: the epoch-opening drain returns at quorum (the
+    fit overlaps the stragglers), every straggler still lands in the
+    archive, and the telemetry proves the overlap happened."""
+
+    def sleepy(pp):
+        time.sleep(0.02)
+        return zdt1_host(pp)
+
+    tel = Telemetry()
+    dmosopt_tpu.run(
+        _params(
+            opt_id="spec", obj_fun=sleepy, n_epochs=3, telemetry=tel,
+            pipeline={"mode": "speculative", "quorum_fraction": 0.5},
+        ),
+        verbose=False,
+    )
+    from dmosopt_tpu.driver import dopt_dict
+
+    dopt = dopt_dict["spec"]
+    assert not dopt._inflight  # every straggler reconciled by run end
+    r = tel.registry
+    assert r.counter_value("eval_quorum_returns_total") >= 1
+    assert r.counter_value("eval_stragglers_total") >= 1
+    # no evaluation was lost to speculation: each drained request is
+    # archived (x rows accumulate initial design + both resample batches)
+    x, y = _archive("spec")
+    assert x.shape[0] == int(r.counter_value("evals_total"))
+    assert np.all(np.isfinite(y))
+    # overlap accounting emitted pipeline events with nonzero overlap
+    assert any(
+        ev.kind == "pipeline" and ev.fields.get("overlap_s", 0) > 0
+        for ev in tel.log.records()
+    )
+
+
+def test_overlap_io_never_counts_quorum():
+    """Quorum/straggler counters are speculative-mode bookkeeping; a
+    plain overlap_io run (even one with slow, out-of-order evals) must
+    report zero for both."""
+
+    def sleepy(pp):
+        time.sleep(0.005)
+        return zdt1_host(pp)
+
+    tel = Telemetry()
+    dmosopt_tpu.run(
+        _params(
+            opt_id="noquorum", obj_fun=sleepy, telemetry=tel,
+            pipeline="overlap_io", n_eval_workers=2,
+        ),
+        verbose=False,
+    )
+    r = tel.registry
+    assert r.counter_value("eval_quorum_returns_total") == 0
+    assert r.counter_value("eval_stragglers_total") == 0
+    # the async path keeps the eval-latency histograms alive (they must
+    # not go dark under the overlap default)
+    batch = r.histogram_summary("eval_batch_duration_seconds", backend="host")
+    assert batch is not None and batch["count"] >= 1
+
+
+def test_time_limit_soft_stop_salvages_completed_results():
+    """A time limit expiring mid-drain: the run stops promptly, and
+    every evaluation that had already completed is folded into the
+    archive (serial folds its whole blocking batch; overlap modes must
+    not silently lose finished results)."""
+
+    def slow(pp):
+        time.sleep(0.15)
+        return zdt1_host(pp)
+
+    tel = Telemetry()
+    t0 = time.perf_counter()
+    dmosopt_tpu.run(
+        _params(
+            opt_id="softstop", obj_fun=slow, telemetry=tel,
+            pipeline="overlap_io", n_epochs=5,
+        ),
+        time_limit=1.0,
+        verbose=False,
+    )
+    assert time.perf_counter() - t0 < 30.0
+    from dmosopt_tpu.driver import dopt_dict
+
+    dopt = dopt_dict["softstop"]
+    assert not dopt._inflight
+    # whatever was counted as evaluated is actually in strategy state
+    strat = dopt.optimizer_dict[0]
+    n_rows = (0 if strat.x is None else strat.x.shape[0]) + len(strat.completed)
+    assert n_rows == dopt.eval_count > 0
+
+
+def test_failed_request_skip_policy_drops_only_that_row():
+    """An objective that raises on one specific request marks only that
+    request failed under on_eval_failure="skip": the run completes and
+    the archive simply misses that row."""
+    bad = {"n": 0}
+
+    def flaky(pp):
+        # fail exactly once, on the first evaluation of epoch-1 resamples
+        if bad["n"] == 6:
+            bad["n"] += 1
+            raise RuntimeError("sensor glitch")
+        bad["n"] += 1
+        return zdt1_host(pp)
+
+    tel = Telemetry()
+    dmosopt_tpu.run(
+        _params(
+            opt_id="skip", obj_fun=flaky, telemetry=tel,
+            pipeline={"mode": "overlap_io", "on_eval_failure": "skip"},
+        ),
+        verbose=False,
+    )
+    r = tel.registry
+    assert r.counter_value("eval_failures_total") == 1
+    x, _ = _archive("skip")
+    # every successful evaluation is archived; only the failed one is gone
+    assert x.shape[0] == int(r.counter_value("evals_total"))
+    assert bad["n"] > 7  # the run continued past the failure
+
+
+def test_skip_policy_rejected_without_surrogate():
+    """No-surrogate mode sends each generation's results back into the
+    epoch generator row-aligned with the x it yielded — a skipped round
+    would misalign everything after it, so the config is rejected up
+    front."""
+    with pytest.raises(ValueError, match="skip"):
+        dmosopt_tpu.run(
+            _params(
+                opt_id="skipnosurr", surrogate_method_name=None,
+                pipeline={"mode": "overlap_io", "on_eval_failure": "skip"},
+            ),
+            verbose=False,
+        )
+
+
+def test_failed_request_raise_policy_aborts():
+    def flaky(pp):
+        raise RuntimeError("dead objective")
+
+    with pytest.raises(RuntimeError, match="failed terminally"):
+        dmosopt_tpu.run(
+            _params(opt_id="raisepol", obj_fun=flaky, pipeline="overlap_io"),
+            verbose=False,
+        )
