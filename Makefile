@@ -22,6 +22,11 @@ lint-metrics:
 bench:
 	python bench.py
 
+# the non-dominated-ranking microbench alone (points ranked/sec + peak
+# live bytes of the tiled sweep vs the dense matrix peel)
+bench-rank:
+	env DMOSOPT_BENCH_ONLY=rank_throughput python bench.py
+
 # Warm .jax_bench_cache with the EXACT programs the round-end bench
 # compiles: one full bench pass, JSON line discarded. Run AFTER the last
 # code commit — any change to optimizer state layouts or jitted program

@@ -46,6 +46,7 @@ from dmosopt_tpu.parallel.evaluator import (
     HostFunEvaluator,
     JaxBatchEvaluator,
 )
+from dmosopt_tpu.ops.dominance import set_rank_telemetry
 from dmosopt_tpu.parallel.pipeline import BackgroundWriter, PipelineConfig
 from dmosopt_tpu.strategy import DistOptStrategy
 from dmosopt_tpu.telemetry import Telemetry, create_telemetry, record_device_memory
@@ -1375,6 +1376,11 @@ def run(
         dopt_params = dict(dopt_params)
         dopt_params["time_limit"] = time_limit
     dopt = dopt_init(dopt_params, verbose=verbose, initialize_strategy=True)
+    # attach the rank kernels' process-level telemetry hook for exactly
+    # the span of this run (None with telemetry=False — zero calls);
+    # detached in the finally below so a finished or aborted run can
+    # never leak its registry into later eager ranking calls
+    set_rank_telemetry(dopt.telemetry)
     dopt.logger.info(f"Optimizing for {dopt.n_epochs} epochs...")
     body_ok = False
     try:
@@ -1423,6 +1429,9 @@ def run(
                 raise
             dopt.logger.exception("background writer close failed")
         finally:
+            # detach the rank-path hook so a later non-telemetry caller
+            # in this process can't record into a closed run's registry
+            set_rank_telemetry(None)
             # only close a Telemetry this run created: a pass-through
             # user-supplied instance may be shared across runs (one JSONL
             # sink for a sweep) and closing it would silently drop the

@@ -3,10 +3,23 @@
 Crowding distance replaces the reference's Python double loop
 (reference: dmosopt/indicators.py:12-51) with argsort + gather +
 scatter-add; mask-aware so it composes with fixed-capacity populations.
+
+Pairwise kernels (`pairwise_distances`, `duplicate_mask`) are
+row-chunked: a `lax.scan` over fixed B-row blocks bounds the live
+pairwise working set to (B, N) instead of (N, N[, d]), the same memory
+model as the tiled dominance sweep (docs/parallel.md "Tiled kernels").
 """
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+
+def _default_row_chunk(n: int) -> int:
+    """Row-block size for chunked pairwise kernels: whole array up to
+    1024 rows (single block == the dense kernel), 1024 beyond."""
+    return n if n <= 1024 else 1024
 
 
 @jax.jit
@@ -72,10 +85,7 @@ def euclidean_distance_metric(Y: jax.Array, mask: jax.Array | None = None) -> ja
 
 
 @jax.jit
-def pairwise_distances(X: jax.Array, Y: jax.Array | None = None) -> jax.Array:
-    """Euclidean cdist as one matmul-friendly expression."""
-    if Y is None:
-        Y = X
+def _pairwise_distances_dense(X, Y):
     x2 = jnp.sum(X**2, axis=1, keepdims=True)
     y2 = jnp.sum(Y**2, axis=1, keepdims=True)
     # highest precision: TPU bf16 matmul default breaks the cancellation
@@ -83,15 +93,48 @@ def pairwise_distances(X: jax.Array, Y: jax.Array | None = None) -> jax.Array:
     return jnp.sqrt(jnp.maximum(sq, 0.0))
 
 
-@jax.jit
-def duplicate_mask(X: jax.Array, eps: float = 1e-16, mask: jax.Array | None = None) -> jax.Array:
-    """Mark rows that duplicate an earlier row (within ``eps`` euclidean
-    distance). Matches reference dmosopt/MOEA.py:426-436: only the
-    upper-triangle (j > i) marks j as duplicate of i; NaN distances ignored.
-    """
+@partial(jax.jit, static_argnames=("row_chunk",))
+def _pairwise_distances_chunked(X, Y, row_chunk: int):
     n = X.shape[0]
-    # Exact difference form (not the matmul identity): duplicate detection
-    # needs distances that are exactly 0.0 for identical rows in f32.
+    T = -(-n // row_chunk)
+    npad = T * row_chunk
+    Xp = jnp.pad(X, ((0, npad - n), (0, 0)))
+    y2 = jnp.sum(Y**2, axis=1, keepdims=True)
+
+    def block(_, Xi):
+        x2 = jnp.sum(Xi**2, axis=1, keepdims=True)
+        sq = x2 + y2.T - 2.0 * jnp.matmul(Xi, Y.T, precision="highest")
+        return None, jnp.sqrt(jnp.maximum(sq, 0.0))
+
+    _, rows = jax.lax.scan(block, None, Xp.reshape(T, row_chunk, -1))
+    return rows.reshape(npad, -1)[:n]
+
+
+def pairwise_distances(
+    X: jax.Array,
+    Y: jax.Array | None = None,
+    row_chunk: int | None = None,
+) -> jax.Array:
+    """Euclidean cdist as a matmul-friendly expression, computed in
+    ``row_chunk``-row blocks so the live working set beyond the (N, M)
+    output stays bounded (single block up to 1024 rows — identical to
+    the old dense kernel there)."""
+    if Y is None:
+        Y = X
+    B = int(row_chunk) if row_chunk is not None else _default_row_chunk(X.shape[0])
+    if B >= X.shape[0]:
+        return _pairwise_distances_dense(X, Y)
+    return _pairwise_distances_chunked(X, Y, B)
+
+
+@jax.jit
+def _duplicate_mask_dense(X, eps, mask):
+    # kept VERBATIM for the single-chunk regime: wrapping the same math
+    # in a lax.scan changes XLA's fusion of the (n, n, f) reduction,
+    # which perturbs borderline D <= eps comparisons by an ulp and was
+    # observed to flip a seeded trajectory — small populations must stay
+    # bit-identical to the historical kernel
+    n = X.shape[0]
     D = jnp.sqrt(jnp.sum((X[:, None, :] - X[None, :, :]) ** 2, axis=-1))
     iu = jnp.triu(jnp.ones((n, n), dtype=bool), k=1)  # D[i, j] with j > i
     near = jnp.where(iu & ~jnp.isnan(D), D <= eps, False)
@@ -99,3 +142,51 @@ def duplicate_mask(X: jax.Array, eps: float = 1e-16, mask: jax.Array | None = No
         valid = mask.astype(bool)
         near = near & valid[:, None] & valid[None, :]
     return jnp.any(near, axis=0)
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _duplicate_mask_chunked(X, eps, mask, chunk: int):
+    n = X.shape[0]
+    valid = jnp.ones((n,), bool) if mask is None else mask.astype(bool)
+    T = -(-n // chunk)
+    npad = T * chunk
+    Xp = jnp.pad(X, ((0, npad - n), (0, 0)))
+    Vp = jnp.pad(valid, (0, npad - n))
+    col = jnp.arange(n)
+
+    def block(dup, c):
+        i0 = c * chunk
+        Xi = jax.lax.dynamic_slice_in_dim(Xp, i0, chunk)
+        Vi = jax.lax.dynamic_slice_in_dim(Vp, i0, chunk)
+        # Exact difference form (not the matmul identity): duplicate
+        # detection needs distances that are exactly 0.0 for identical
+        # rows in f32. (chunk, n) live — never (n, n, f).
+        D = jnp.sqrt(jnp.sum((Xi[:, None, :] - X[None, :, :]) ** 2, axis=-1))
+        gi = i0 + jnp.arange(chunk)
+        iu = (gi[:, None] < col[None, :]) & (gi < n)[:, None]
+        near = jnp.where(iu & ~jnp.isnan(D), D <= eps, False)
+        near = near & Vi[:, None] & valid[None, :]
+        return dup | jnp.any(near, axis=0), None
+
+    dup, _ = jax.lax.scan(block, jnp.zeros((n,), bool), jnp.arange(T))
+    return dup
+
+
+def duplicate_mask(
+    X: jax.Array,
+    eps: float = 1e-16,
+    mask: jax.Array | None = None,
+    chunk: int | None = None,
+) -> jax.Array:
+    """Mark rows that duplicate an earlier row (within ``eps`` euclidean
+    distance). Matches reference dmosopt/MOEA.py:426-436: only the
+    upper-triangle (j > i) marks j as duplicate of i; NaN distances
+    ignored. Populations within one chunk (default 1024 rows) use the
+    historical dense kernel bit-for-bit; larger ones stream row blocks
+    so (n, n, f) never materializes (agreement pinned by
+    tests/test_ops.py).
+    """
+    B = int(chunk) if chunk is not None else _default_row_chunk(X.shape[0])
+    if B >= X.shape[0]:
+        return _duplicate_mask_dense(X, eps, mask)
+    return _duplicate_mask_chunked(X, eps, mask, B)

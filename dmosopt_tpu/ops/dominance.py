@@ -1,20 +1,36 @@
-"""Non-dominated sorting via the Dominance Degree Matrix, as one XLA kernel.
+"""Non-dominated sorting as tiled, memory-bounded XLA kernels.
 
-The reference implements Zhou et al. 2017 with per-objective argsort loops and
-sequential front insertion (reference: dmosopt/dda.py:13-152). The key
-observation for a TPU: the per-objective comparison matrix constructed there
-is exactly ``C[a, b] = (y[a] <= y[b])`` (ties give 1 in both directions), so
-the full dominance degree matrix is a single broadcast-compare-reduce over an
-``(N, N, d)`` tensor — no sorting, no Python loops. Front assignment peels
-ranks with a ``lax.while_loop`` (one iteration per front, not per point).
+The reference implements Zhou et al. 2017 with per-objective argsort loops
+and sequential front insertion (reference: dmosopt/dda.py:13-152). Three
+routes replace it here, all producing *bitwise identical* ranks (pinned by
+tests/test_ops.py):
 
-Bi-objective populations take a different route entirely: for d == 2 the
-front index equals the patience-sorting pile index over the population
-sorted by (f1, f2) — an O(N log N) scanned sweep (Jensen's bi-objective
-ENS specialization) that never materializes the (N, N) matrix. At the
-flagship SMPSO scale (5 swarms x 12288 candidates) this is ~20x faster
-than the peeled matrix on CPU and produces *bitwise identical* ranks
-(pinned by tests/test_ops.py), so every d == 2 trajectory is unchanged.
+- d == 2 (floating): the front index equals the patience-sorting pile
+  index over the population sorted by (f1, f2) — an O(N log N) scanned
+  sweep (Jensen's bi-objective ENS specialization). At the flagship SMPSO
+  scale (5 swarms x 12288 candidates) this is ~20x faster than the peeled
+  matrix on CPU.
+
+- d >= 3: a **tiled pairwise sweep** (`_rank_tiled`). The population is
+  lex-sorted by its objective vector — a topological order of the
+  dominance DAG (a dominator is lexicographically strictly smaller than
+  anything it dominates) — then processed in fixed B-row tiles by a
+  `lax.scan`. Each tile's rank is the length of its longest dominator
+  chain: cross-tile dominators contribute through a `fori_loop` over the
+  already-ranked prefix (one (B, B) dominance-count block at a time,
+  objectives unrolled so no (B, B, d) tensor exists either), and
+  within-tile chains resolve by a fixed-point `while_loop` whose
+  iteration count is the tile's chain depth, not the global front count.
+  Peak live memory is O(N·d + B²) — never (N, N, d) nor (N, N) — so
+  populations of 16k+ rank on hosts where the dense peel OOMs.
+
+- `_rank_matrix_peel` (the dense dominance-degree matrix + front peel)
+  is retained as the reference oracle the other two routes are
+  equivalence-pinned against, and for callers that explicitly want it.
+
+A `shard_map` variant that splits the tiled sweep's compare work over a
+mesh's population axis with explicit `pmax` collectives lives in
+`dmosopt_tpu.parallel.mesh.non_dominated_rank_sharded`.
 
 All functions are shape-static and mask-aware so populations can live in
 fixed-capacity arrays (masked slots get rank ``n``).
@@ -24,6 +40,22 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+# Optional process-level telemetry hook (set by the driver): the rank
+# dispatcher records tile statistics on *eager* calls only — inside a jit
+# trace there is one symbolic call per compilation, so counting there
+# would be meaningless. See `set_rank_telemetry`.
+_TELEMETRY = None
+
+
+def set_rank_telemetry(tel) -> None:
+    """Attach a `dmosopt_tpu.telemetry.Telemetry` (or None) to the rank
+    path. Eager `non_dominated_rank` calls with d >= 3 then record
+    `rank_tile_sweeps_total`, `rank_peel_iterations_total` and the
+    `rank_tile_size` gauge. Process-global; the driver sets it to its
+    telemetry object for the run and clears it on teardown."""
+    global _TELEMETRY
+    _TELEMETRY = tel
 
 
 def comparison_matrix(y: jax.Array) -> jax.Array:
@@ -43,6 +75,7 @@ def dominance_degree_matrix(Y: jax.Array) -> jax.Array:
     return (Y[:, None, :] <= Y[None, :, :]).sum(axis=-1).astype(jnp.int32)
 
 
+@jax.jit
 def _rank_biobjective_sweep(Y: jax.Array, mask: jax.Array | None) -> jax.Array:
     """Exact non-dominated ranks for d == 2 as a patience-sorting sweep.
 
@@ -107,46 +140,178 @@ def _rank_biobjective_sweep(Y: jax.Array, mask: jax.Array | None) -> jax.Array:
     return jnp.where(valid, rank, n)
 
 
-@partial(jax.jit, static_argnames=("stop_count",))
+def _default_tile_size(n: int) -> int:
+    """Tile edge for the tiled rank sweep: the smallest power of two >= 64
+    covering ``n``, capped at 512 (a lane-friendly multiple of 128 that
+    keeps every (B, B) work block a few MB)."""
+    t = 64
+    while t < n and t < 512:
+        t *= 2
+    return t
+
+
+def _tile_counts(Ya: jax.Array, Yb: jax.Array, d: int) -> jax.Array:
+    """``c[i, j]`` = number of objectives with ``Ya[i, k] <= Yb[j, k]``,
+    accumulated one objective at a time so only (|Ya|, |Yb|) lives —
+    never an (|Ya|, |Yb|, d) tensor (NaN comparisons count as False,
+    matching `dominance_degree_matrix`)."""
+    c = jnp.zeros((Ya.shape[0], Yb.shape[0]), jnp.int32)
+    for k in range(d):  # d is small and static; unrolled adds fuse
+        c = c + (Ya[:, k][:, None] <= Yb[:, k][None, :]).astype(jnp.int32)
+    return c
+
+
+def _propagate_tile(best: jax.Array, dom_in: jax.Array):
+    """Resolve within-tile dominator chains to a fixed point.
+
+    ``best[j]`` carries the longest-chain rank contribution from outside
+    the tile; ``dom_in[i, j]`` marks i dominating j inside the tile. The
+    tile is lex-sorted, so within-tile dominance only points forward
+    (i < j) and the iteration converges in (within-tile chain depth)
+    sweeps — each a (B, B) masked max, no dense front peel. Returns
+    (ranks, iterations)."""
+
+    def cond(state):
+        return state[1]
+
+    def body(state):
+        r, _, it = state
+        nxt = jnp.maximum(
+            best, jnp.max(jnp.where(dom_in, r[:, None] + 1, 0), axis=0)
+        )
+        return nxt, jnp.any(nxt != r), it + jnp.int32(1)
+
+    r, _, iters = jax.lax.while_loop(
+        cond, body, (best, jnp.any(dom_in), jnp.int32(0))
+    )
+    return r, iters
+
+
+def _lex_topo_perm(Y: jax.Array) -> jax.Array:
+    """Permutation sorting rows lexicographically by objective vector —
+    a linear extension of the dominance partial order: a dominator has
+    every coordinate <= and at least one < its dominee's, so it sorts
+    strictly earlier. Rows containing NaN neither dominate nor are
+    dominated (every comparison with NaN is False), so their placement
+    is free."""
+    d = Y.shape[1]
+    return jnp.lexsort(tuple(Y[:, k] for k in range(d - 1, -1, -1)))
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def _rank_tiled(
+    Y: jax.Array,
+    mask: jax.Array | None = None,
+    tile: int = 512,
+):
+    """Exact non-dominated ranks for any d via the tiled pairwise sweep
+    (see the module docstring). Bitwise-identical to `_rank_matrix_peel`
+    with ``stop_count=None`` (pinned by tests/test_ops.py): the front
+    index of a point equals the length of its longest dominator chain,
+    and chains resolve tile-by-tile along the lex-sorted topological
+    order. Returns ``(ranks, peel_iterations)`` where the second value
+    counts within-tile fixed-point sweeps (the tiled analogue of the
+    matrix path's one-front-per-iteration peel count)."""
+    n, d = Y.shape
+    valid = jnp.ones((n,), bool) if mask is None else mask.astype(bool)
+    B = int(tile)
+    T = -(-n // B)
+    perm = _lex_topo_perm(Y)
+
+    if T == 1:  # single tile: no padding, no cross-tile pass
+        Yc, Vc = Y[perm], valid[perm]
+        cc = _tile_counts(Yc, Yc, d)
+        dom_in = (cc == d) & (cc.T < d) & Vc[:, None] & Vc[None, :]
+        r, iters = _propagate_tile(jnp.zeros((n,), jnp.int32), dom_in)
+        rank = jnp.zeros((n,), jnp.int32).at[perm].set(r)
+        return jnp.where(valid, rank, n), iters
+
+    npad = T * B
+    Ys = jnp.pad(Y[perm], ((0, npad - n), (0, 0)))
+    Vs = jnp.pad(valid[perm], (0, npad - n))  # padding rows never dominate
+
+    def outer(carry, t):
+        ranks, iters = carry
+        off = t * B
+        Yc = jax.lax.dynamic_slice_in_dim(Ys, off, B)
+        Vc = jax.lax.dynamic_slice_in_dim(Vs, off, B)
+
+        def cross(s, best):
+            # contribution of already-ranked tile s (< t) to tile t
+            Yp = jax.lax.dynamic_slice_in_dim(Ys, s * B, B)
+            Vp = jax.lax.dynamic_slice_in_dim(Vs, s * B, B)
+            rp = jax.lax.dynamic_slice_in_dim(ranks, s * B, B)
+            ca = _tile_counts(Yp, Yc, d)
+            cb = _tile_counts(Yc, Yp, d)
+            dom = (ca == d) & (cb.T < d) & Vp[:, None] & Vc[None, :]
+            return jnp.maximum(
+                best, jnp.max(jnp.where(dom, rp[:, None] + 1, 0), axis=0)
+            )
+
+        best = jax.lax.fori_loop(0, t, cross, jnp.zeros((B,), jnp.int32))
+        cc = _tile_counts(Yc, Yc, d)
+        dom_in = (cc == d) & (cc.T < d) & Vc[:, None] & Vc[None, :]
+        r, it = _propagate_tile(best, dom_in)
+        ranks = jax.lax.dynamic_update_slice_in_dim(ranks, r, off, axis=0)
+        return (ranks, iters + it), None
+
+    (ranks, iters), _ = jax.lax.scan(
+        outer, (jnp.zeros((npad,), jnp.int32), jnp.int32(0)), jnp.arange(T)
+    )
+    rank = jnp.zeros((n,), jnp.int32).at[perm].set(ranks[:n])
+    return jnp.where(valid, rank, n), iters
+
+
 def non_dominated_rank(
     Y: jax.Array,
     mask: jax.Array | None = None,
     stop_count: int | None = None,
+    tile: int | None = None,
 ) -> jax.Array:
     """Rank points into non-dominated fronts (0 = best).
 
     Semantics match reference dmosopt/dda.py:50-133 (``dda_ns`` /
-    ``dda_ens`` produce the same ranking): build the dominance degree
-    matrix, zero out ties (identical objective vectors do not dominate each
-    other), then peel fronts.
+    ``dda_ens`` produce the same ranking); every route is bitwise
+    equivalence-pinned against the dominance-degree matrix peel.
 
     Y: (n, d) objective matrix (minimization).
     mask: optional (n,) bool; invalid rows get rank ``n`` and never dominate.
-    stop_count: static; stop peeling once at least this many points are
-        ranked — survival selections of the best ``k`` of ``n`` only need
-        the fronts covering ``k``, and each peel is a full (n, n)
-        reduction. Leftover valid points get rank ``n - 1`` (a legal
-        segment index, ordered after every exactly-ranked front; relative
-        order beyond the cut is unspecified). The bi-objective sweep
-        ignores it — exact ranks everywhere are cheaper than any stopped
-        peel, and exact-beyond-the-cut is a legal refinement of the
-        unspecified-beyond-cut contract.
+    stop_count: static; contract inherited from the stopped matrix peel —
+        every front covering the best ``stop_count`` points is exact, the
+        relative order beyond the cut is unspecified. Both live routes
+        (the d == 2 sweep and the d >= 3 tiled sweep) return exact ranks
+        everywhere, a legal refinement of that contract: exact ranks cost
+        them no extra peels, unlike the dense matrix path the contract
+        was written for.
+    tile: static tile edge for the d >= 3 tiled sweep (default: chosen by
+        `_default_tile_size`; peak live memory is O(n·d + tile²)).
     Returns (n,) int32 ranks.
     """
     n, d = Y.shape
     if d == 2 and jnp.issubdtype(Y.dtype, jnp.floating):
         return _rank_biobjective_sweep(Y, mask)
-    return _rank_matrix_peel(Y, mask, stop_count)
+    B = int(tile) if tile is not None else _default_tile_size(n)
+    rank, iters = _rank_tiled(Y, mask, tile=B)
+    tel = _TELEMETRY
+    if tel is not None and not isinstance(Y, jax.core.Tracer):
+        T = -(-n // B)
+        tel.inc("rank_tile_sweeps_total", T * (T + 1) // 2)
+        tel.inc("rank_peel_iterations_total", int(iters))
+        tel.gauge("rank_tile_size", B)
+    return rank
 
 
+@partial(jax.jit, static_argnames=("stop_count",))
 def _rank_matrix_peel(
     Y: jax.Array,
     mask: jax.Array | None = None,
     stop_count: int | None = None,
 ) -> jax.Array:
-    """General-d rank via the dominance degree matrix + front peeling
-    (see `non_dominated_rank` for the contract). The d == 2 sweep is
-    equivalence-pinned against this path in tests/test_ops.py."""
+    """Reference rank via the dense dominance degree matrix + front
+    peeling (see `non_dominated_rank` for the contract). Materializes
+    (n, n) work arrays, so it does not scale past a few thousand rows —
+    it survives as the oracle both live routes (the d == 2 sweep and the
+    tiled sweep) are equivalence-pinned against in tests/test_ops.py."""
     n, d = Y.shape
     D = dominance_degree_matrix(Y)
     # Identical vectors: D[i,j] == D[j,i] == d -> neither dominates

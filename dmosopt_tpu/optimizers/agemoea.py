@@ -36,6 +36,12 @@ from dmosopt_tpu.ops import (
 
 _INF = jnp.inf
 
+# Candidate-count ceiling for the dense (N, N) Minkowski matrix in the
+# survival score; larger fronts switch to on-demand columns (see
+# `_survival_score`). 2048 ~ 16 MB f32 — comfortably below the tiled
+# rank path's own footprint at that scale.
+_DENSE_SURVIVAL_MAX = 2048
+
 
 def _point_to_line_distance(P, B):
     """Distance of each row of P to the line through the origin along B
@@ -129,23 +135,64 @@ def _survival_score(y, front_mask, ideal):
     ynfront = yfront / normalization
     p = jnp.where(small, 1.0, _get_geometry(ynfront, front_mask, extreme))
 
-    # pairwise Minkowski-p distances scaled by each point's norm
+    # Minkowski-p distances scaled by each point's norm. Two regimes,
+    # selected statically on the candidate count:
+    #
+    # - N <= _DENSE_SURVIVAL_MAX: the original (N, N) matrix. Kept not
+    #   for speed but for bit-stability: the column-on-demand expression
+    #   below lands one f32 ulp away from the fused matrix reduction,
+    #   which is enough to flip greedy argmax decisions and diverge
+    #   whole trajectories — every pinned benchmark population lives in
+    #   this regime and must stay bitwise identical.
+    # - beyond it, pairwise columns are computed ON DEMAND — the memory
+    #   model of the tiled rank sweep (docs/parallel.md): the greedy loop
+    #   consumes one column per step and the incremental two-smallest
+    #   maintenance seeds from the <= d corner columns, so the (N, N)
+    #   matrix (and its (N, N, d) difference tensor) never exists and
+    #   16k+ fronts fit in memory.
     nn = jnp.sum(jnp.abs(ynfront) ** p, axis=1) ** (1.0 / p)
-    D = jnp.sum(
-        jnp.abs(ynfront[:, None, :] - ynfront[None, :, :]) ** p, axis=2
-    ) ** (1.0 / p)
-    D = D / jnp.where(nn[:, None] == 0, 1.0, nn[:, None])
+    nn_div = jnp.where(nn == 0, 1.0, nn)
+    dense = N <= _DENSE_SURVIVAL_MAX
+
+    if dense:
+        D = jnp.sum(
+            jnp.abs(ynfront[:, None, :] - ynfront[None, :, :]) ** p, axis=2
+        ) ** (1.0 / p)
+        D = D / jnp.where(nn[:, None] == 0, 1.0, nn[:, None])
+
+        def dist_col(j):
+            return D[:, j]
+
+    else:
+
+        def dist_col(j):
+            # D[:, j]: each point's scaled Minkowski-p distance to point j
+            d_j = jnp.sum(jnp.abs(ynfront - ynfront[j][None, :]) ** p, axis=1)
+            return d_j ** (1.0 / p) / nn_div
 
     selected = jnp.zeros((N,), bool).at[extreme].set(True) & front_mask
     crowd = jnp.where(selected, _INF, 0.0)
     n_greedy = jnp.maximum(m - selected.sum(), 0)
 
     # Each point's two smallest distances to the selected set, maintained
-    # incrementally: recomputing them from the masked (N, N) matrix every
+    # incrementally: recomputing them from a masked (N, N) matrix every
     # iteration makes the greedy loop O(N^2) per step; folding in only the
     # newly selected column keeps it O(N).
-    Dsel = jnp.where(selected[None, :], D, _INF)
-    neg_top2, _ = jax.lax.top_k(-Dsel, 2)
+    if dense:
+        Dsel = jnp.where(selected[None, :], D, _INF)
+        neg_top2, _ = jax.lax.top_k(-Dsel, 2)
+    else:
+        # seed from the corner-solution columns (the initial selected
+        # set), deduplicated — a corner index repeated by the
+        # degenerate-fill path must contribute one column, exactly as it
+        # holds one column in the full matrix
+        corner_cols = jax.vmap(dist_col)(extreme)  # (d, N)
+        eq = extreme[:, None] == extreme[None, :]
+        first_occurrence = ~jnp.any(jnp.tril(eq, k=-1), axis=1)
+        col_live = selected[extreme] & first_occurrence
+        neg_top2, _ = jax.lax.top_k(
+            -jnp.where(col_live[:, None], corner_cols, _INF).T, 2
+        )
     min1, min2 = -neg_top2[:, 0], -neg_top2[:, 1]
 
     def body(i, carry):
@@ -159,7 +206,7 @@ def _survival_score(y, front_mask, ideal):
         crowd = jnp.where(do, crowd.at[best].set(val[best]), crowd)
         selected = jnp.where(do, selected.at[best].set(True), selected)
         # fold the newly selected point's distance column into the mins
-        dnew = jnp.where(do, D[:, best], _INF)
+        dnew = jnp.where(do, dist_col(best), _INF)
         min1_next = jnp.minimum(min1, dnew)
         min2_next = jnp.where(
             dnew < min1, jnp.minimum(min2, min1), jnp.minimum(min2, dnew)

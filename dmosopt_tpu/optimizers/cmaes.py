@@ -203,7 +203,7 @@ class CMAES(MOEA):
         pidx = state.gen_pidx
 
         cand_y = jnp.concatenate([y_gen, state.parents_y], axis=0)
-        sel_idx, chosen, rank = front_fill_selection(cand_y, P)
+        sel_idx, chosen, rank, _ = front_fill_selection(cand_y, P)
         chosen_off = chosen[:C]
 
         # --- offspring strategy parameters, as if chosen (unchosen ones are

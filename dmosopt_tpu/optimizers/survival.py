@@ -21,11 +21,18 @@ heuristic plays), mask-aware and fully jittable, so the whole selection
 is one fused program with static shapes, scannable inside the
 generation loop:
 
-- non-dominated rank (one (N,N,d) reduction, already on device),
+- non-dominated rank (the tiled memory-bounded sweep of
+  `ops/dominance.py` for d >= 3, the scanned sweep for d == 2),
 - per-front sizes/offsets via segment-sum + cumsum,
 - fronts that fit entirely are taken; the first front that overflows is
   broken by masked crowding distance,
 - the final pick is a single stable argsort on (rank, -score).
+
+The jit boundary is kept exactly where it always was (a nested-pjit
+call inside the consumers' update steps) — moving it changes XLA fusion
+by an ulp in the crowding tie-break, the same silent trajectory hazard
+the dense/duplicate kernels guard against. The single-computation
+contract is pinned by a call-count test at trace time.
 """
 
 from __future__ import annotations
@@ -43,20 +50,37 @@ from dmosopt_tpu.ops import crowding_distance, non_dominated_rank
 def front_fill_selection(
     candidates_y: jax.Array,
     popsize: int,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    rank: jax.Array | None = None,
+    crowding: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Select exactly ``popsize`` of ``candidates_y`` (N > popsize, static).
 
-    Returns (sel_idx, chosen, rank): ``sel_idx`` (popsize,) gather indices
-    ordered by (rank, -crowding), ``chosen`` (N,) boolean mask, ``rank``
-    (N,) non-dominated ranks — exact for every selected candidate (and any
-    front touching the cut); candidates beyond the stopped peel carry the
-    sentinel ``N - 1``, not their true rank.
+    Single-computation path: the ranking and the mid-front crowding are
+    each computed AT MOST ONCE per trace (pinned by a call-count test in
+    tests/test_optimizers.py), and callers that already hold them pass
+    them in to skip the recompute entirely:
+
+    - ``rank``: (N,) non-dominated ranks of ``candidates_y`` — any legal
+      `non_dominated_rank(..., stop_count=popsize)` result (exact ranks,
+      a strict refinement, are equally valid).
+    - ``crowding``: (N,) raw crowding distances computed within the
+      first front that overflows ``popsize`` (`crowding_distance` with
+      the mid-front mask), zero elsewhere — i.e. the fourth return value
+      of a previous call on the same candidates.
+
+    Returns (sel_idx, chosen, rank, crowding): ``sel_idx`` (popsize,)
+    gather indices ordered by (rank, -crowding), ``chosen`` (N,) boolean
+    mask, ``rank`` (N,) ranks — exact for every selected candidate (and
+    any front touching the cut; the contract leaves candidates beyond
+    the covering fronts unspecified), ``crowding`` the raw mid-front
+    crowding scores (reusable as above).
     """
     y = candidates_y.astype(jnp.float32)
     n = y.shape[0]
-    # peel only the fronts covering the selection; leftovers rank n-1,
-    # whose front_start lands at/after popsize so they are never mid-front
-    rank = non_dominated_rank(y, stop_count=popsize)
+    if rank is None:
+        # peel only the fronts covering the selection; beyond-cut ranks
+        # order after every covering front, so they are never mid-front
+        rank = non_dominated_rank(y, stop_count=popsize)
 
     sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), rank, num_segments=n)
     starts = jnp.cumsum(sizes) - sizes
@@ -66,11 +90,12 @@ def front_fill_selection(
     fully_chosen = front_end <= popsize  # whole front fits
     in_mid = (front_start < popsize) & ~fully_chosen
 
-    scores = crowding_distance(y, mask=in_mid)
+    if crowding is None:
+        crowding = crowding_distance(y, mask=in_mid)
     # tie-break stays strictly inside one rank unit
-    scores = scores / (jnp.max(scores) + 1e-9) * 0.999
+    scores = crowding / (jnp.max(crowding) + 1e-9) * 0.999
 
     order = jnp.argsort(rank.astype(jnp.float32) - scores, stable=True)
     sel_idx = order[:popsize]
     chosen = jnp.zeros((n,), bool).at[sel_idx].set(True)
-    return sel_idx, chosen, rank
+    return sel_idx, chosen, rank, crowding

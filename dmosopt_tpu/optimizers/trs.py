@@ -156,7 +156,7 @@ class TRS(MOEA):
         state = jax.lax.cond(state.restart, do_restart, lambda s: s, state)
 
         cand_y = jnp.concatenate([y_gen, state.population_obj], axis=0)
-        sel_idx, chosen, rank = front_fill_selection(cand_y, P)
+        sel_idx, chosen, rank, _ = front_fill_selection(cand_y, P)
 
         # success-window trust-region control (reference TRS.py:268-292)
         succ = jnp.sum(chosen[:C].astype(jnp.float32))

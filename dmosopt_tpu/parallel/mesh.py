@@ -15,14 +15,24 @@ SURVEY §2.2/§5.8). The TPU-native equivalents provided here:
   population axis and replicate everything else, so EA kernels run
   sharded and XLA inserts the collectives the global sorts need.
 - `replicate`: explicit replication for small arrays.
+- `non_dominated_rank_sharded`: the tiled ranking sweep of
+  `ops/dominance.py` as an explicit-collective `shard_map` program over
+  the mesh's population axis — each device scores its own slice of the
+  lex-sorted population against the current tile and a single `pmax`
+  merges the per-device longest-chain contributions, instead of leaving
+  the pairwise reduction to auto-sharding.
 """
 
 from __future__ import annotations
 
+import math
+from functools import lru_cache
 from typing import Optional, Sequence
 
 import numpy as np
 import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
@@ -70,6 +80,110 @@ def replicate(mesh: Mesh):
 def shard_population(x, mesh: Mesh, axis: str = "pop"):
     """Place one array with its leading axis sharded over `axis`."""
     return jax.device_put(x, population_sharding(mesh, axis))
+
+
+@lru_cache(maxsize=32)
+def _build_sharded_rank(mesh: Mesh, axis: str, n: int, d: int, tile: int, npad: int):
+    """Compile-cached builder for the sharded tiled ranking program.
+
+    Layout: the lex-sorted population is passed twice — row-sharded over
+    ``axis`` (each device's compare source) and replicated (the current
+    tile every device scores against; (npad, d) is tiny next to any
+    pairwise block). The rank carry is replicated and updated identically
+    on every device; the only cross-device traffic is one (B,) `pmax`
+    per tile. Integer max is exactly associative, so the result is
+    bitwise-identical to the single-device `_rank_tiled` sweep."""
+    from dmosopt_tpu.ops.dominance import (
+        _lex_topo_perm,
+        _propagate_tile,
+        _tile_counts,
+    )
+
+    B = tile
+    T = npad // B
+    n_shards = mesh.shape[axis]
+    L = npad // n_shards
+
+    def body(Ysh, Vsh, Yfull, Vfull):
+        p = jax.lax.axis_index(axis)
+        gidx = p * L + jnp.arange(L)  # global sorted-order row ids
+
+        def outer(carry, t):
+            ranks, iters = carry
+            off = t * B
+            Yc = jax.lax.dynamic_slice_in_dim(Yfull, off, B)
+            Vc = jax.lax.dynamic_slice_in_dim(Vfull, off, B)
+            rloc = jax.lax.dynamic_slice_in_dim(ranks, p * L, L)
+            ca = _tile_counts(Ysh, Yc, d)  # (L, B)
+            cb = _tile_counts(Yc, Ysh, d)  # (B, L)
+            dom = (ca == d) & (cb.T < d) & Vsh[:, None] & Vc[None, :]
+            # only the already-ranked prefix (tiles before t) contributes
+            dom = dom & (gidx < off)[:, None]
+            local_best = jnp.max(jnp.where(dom, rloc[:, None] + 1, 0), axis=0)
+            best = jax.lax.pmax(local_best, axis)
+            cc = _tile_counts(Yc, Yc, d)
+            dom_in = (cc == d) & (cc.T < d) & Vc[:, None] & Vc[None, :]
+            r, it = _propagate_tile(best, dom_in)
+            ranks = jax.lax.dynamic_update_slice_in_dim(ranks, r, off, axis=0)
+            return (ranks, iters + it), None
+
+        (ranks, iters), _ = jax.lax.scan(
+            outer, (jnp.zeros((npad,), jnp.int32), jnp.int32(0)), jnp.arange(T)
+        )
+        return ranks, iters
+
+    smapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            PartitionSpec(axis),
+            PartitionSpec(axis),
+            PartitionSpec(),
+            PartitionSpec(),
+        ),
+        out_specs=(PartitionSpec(), PartitionSpec()),
+        check_rep=False,  # axis_index defeats the replication checker
+    )
+
+    @jax.jit
+    def ranked(Y, valid):
+        perm = _lex_topo_perm(Y)
+        Ys = jnp.pad(Y[perm], ((0, npad - n), (0, 0)))
+        Vs = jnp.pad(valid[perm], (0, npad - n))
+        ranks, iters = smapped(Ys, Vs, Ys, Vs)
+        rank = jnp.zeros((n,), jnp.int32).at[perm].set(ranks[:n])
+        return jnp.where(valid, rank, n), iters
+
+    return ranked
+
+
+def non_dominated_rank_sharded(
+    Y,
+    mesh: Mesh,
+    axis: str = "pop",
+    mask=None,
+    tile: Optional[int] = None,
+):
+    """Non-dominated ranks computed with the pairwise compare work split
+    over ``mesh``'s ``axis`` (see `_build_sharded_rank`). Bitwise-equal
+    to `ops.dominance.non_dominated_rank`'s tiled route (pinned by
+    tests/test_parallel.py on the forced 8-device CPU mesh); per-device
+    compare work drops to N²/(mesh axis size) and peak live memory stays
+    O(N·d + (N/shards)·tile)."""
+    from dmosopt_tpu.ops.dominance import _default_tile_size
+
+    Y = jnp.asarray(Y)
+    n, d = Y.shape
+    B = int(tile) if tile is not None else _default_tile_size(n)
+    # padded length must split into whole tiles AND equal device shards
+    step = math.lcm(B, int(mesh.shape[axis]))
+    npad = -(-n // step) * step
+    valid = (
+        jnp.ones((n,), bool) if mask is None else jnp.asarray(mask).astype(bool)
+    )
+    fn = _build_sharded_rank(mesh, axis, n, d, B, npad)
+    rank, _ = fn(Y, valid)
+    return rank
 
 
 def shard_state(state, pop: int, mesh: Mesh, axis: str = "pop"):

@@ -122,3 +122,23 @@ def test_dtlz7_m5_archive_quality_floor():
         f"DTLZ7-m5 final HV {final_hv:.4f} below the 10.0 floor — "
         f"surrogate-fit accuracy regressed (see BASELINE.md round-5)"
     )
+
+
+@pytest.mark.slow
+def test_rank_throughput_microbench_memory_bound():
+    """The `rank_throughput` microbench (large-pop evidence for the
+    tiled ranking path): at pop 4096 x d 5 the tiled program's peak
+    temp allocation must undercut the dense matrix peel's by >= 5x, and
+    pop 16384 must complete — the scale where the peel's ~1.3 GB of
+    (N, N) temporaries makes it unrunnable on this host."""
+    import bench
+
+    out = bench.bench_rank_throughput(pops=(4096, 16384), dims=(5,))
+    rows = out["rank_throughput"]
+    r4k = rows["rank_pop4096_d5"]
+    assert r4k["peak_bytes_ratio"] >= 5.0, r4k
+    assert r4k["points_per_sec"] > 0 and r4k["peel_wall_sec"] > 0
+    r16k = rows["rank_pop16384_d5"]
+    assert r16k["points_per_sec"] > 0  # tiled path actually ran at 16k
+    assert r16k["peel_peak_temp_bytes"] > 1e9  # the blowup being removed
+    assert r16k["tiled_peak_temp_bytes"] * 5 < r16k["peel_peak_temp_bytes"]

@@ -159,6 +159,107 @@ def test_biobjective_sweep_stop_count_refinement(rng):
         assert got[~peeled].min() > ref[peeled].max()
 
 
+@pytest.mark.parametrize("d", [2, 3, 5])
+def test_tiled_rank_bitwise_matches_matrix_peel(d, rng):
+    """The tiled sweep must be BITWISE identical to the dense matrix
+    peel for every d — duplicates, shared coordinates, NaN rows,
+    infinities, masks, and tile sizes that do not divide the population
+    included — so rerouting d >= 3 ranking through it changes no
+    trajectory."""
+    from dmosopt_tpu.ops.dominance import _rank_matrix_peel, _rank_tiled
+
+    for trial in range(20):
+        n = int(rng.integers(3, 150))
+        Y = rng.random((n, d)).astype(np.float32)
+        if n > 10:
+            Y[rng.integers(0, n, 5)] = Y[rng.integers(0, n, 5)]  # dup rows
+            Y[rng.integers(0, n, 5), 0] = Y[rng.integers(0, n, 5), 0]  # ties
+        if trial % 5 == 1:
+            Y[rng.integers(0, n, max(1, n // 8)), d - 1] = np.nan
+        if trial % 7 == 2:
+            Y[rng.integers(0, n, max(1, n // 8)), 0] = np.inf
+        mask = None
+        if trial % 3 == 0:
+            mask = jnp.asarray(rng.random(n) > 0.3)
+        tile = int(rng.choice([16, 48, 64, 100, 512]))  # rarely divides n
+        ref = np.asarray(_rank_matrix_peel(jnp.asarray(Y), mask=mask))
+        got, iters = _rank_tiled(jnp.asarray(Y), mask, tile=tile)
+        np.testing.assert_array_equal(
+            np.asarray(got), ref, err_msg=f"trial {trial} tile {tile}"
+        )
+        assert int(iters) >= 0
+
+
+@pytest.mark.parametrize("d", [3, 5])
+def test_rank_routing_matches_peel_general_d(d, rng):
+    """The public dispatcher's d >= 3 route (tiled) equals the peel,
+    including with masks — the contract every consumer relies on."""
+    from dmosopt_tpu.ops.dominance import _rank_matrix_peel
+
+    Y = rng.random((130, d)).astype(np.float32)
+    Y[3:7] = Y[20:24]  # duplicates across the array
+    mask = jnp.asarray(rng.random(130) > 0.25)
+    for m in (None, mask):
+        ref = np.asarray(_rank_matrix_peel(jnp.asarray(Y), mask=m))
+        got = np.asarray(non_dominated_rank(jnp.asarray(Y), mask=m))
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_tiled_rank_stop_count_refinement(rng):
+    """With stop_count the tiled route returns exact ranks beyond the
+    cut (the matrix path's n-1 sentinel is one legal answer, exactness
+    another) — ranks within the peeled fronts must agree exactly, and
+    beyond-cut ranks must order strictly after them (the property
+    survival slicing relies on). Mirrors the d == 2 sweep's pin."""
+    from dmosopt_tpu.ops.dominance import _rank_matrix_peel
+
+    Y = jnp.asarray(rng.random((90, 4)).astype(np.float32))
+    ref = np.asarray(_rank_matrix_peel(Y, stop_count=30))
+    got = np.asarray(non_dominated_rank(Y, stop_count=30))
+    peeled = ref < 89  # matrix path: unpeeled rows carry the n-1 sentinel
+    np.testing.assert_array_equal(got[peeled], ref[peeled])
+    if (~peeled).any():
+        assert got[~peeled].min() > ref[peeled].max()
+
+
+def test_tiled_rank_inside_jit(rng):
+    """Ranking must stay traceable — every optimizer calls it inside a
+    jitted update step."""
+    Y = rng.random((64, 3)).astype(np.float32)
+
+    @jax.jit
+    def ranked(y):
+        return non_dominated_rank(y)
+
+    np.testing.assert_array_equal(
+        np.asarray(ranked(jnp.asarray(Y))),
+        np.asarray(non_dominated_rank(jnp.asarray(Y))),
+    )
+
+
+def test_rank_telemetry_counters(rng):
+    """Eager d >= 3 calls with a telemetry hook attached record the tile
+    statistics; detaching the hook stops recording."""
+    from dmosopt_tpu.ops import dominance
+    from dmosopt_tpu.telemetry import Telemetry
+
+    tel = Telemetry()
+    dominance.set_rank_telemetry(tel)
+    try:
+        non_dominated_rank(jnp.asarray(rng.random((40, 3)), jnp.float32))
+    finally:
+        dominance.set_rank_telemetry(None)
+    reg = tel.registry
+    assert reg.counter_value("rank_tile_sweeps_total") >= 1
+    assert reg.counter_value("rank_peel_iterations_total") >= 0
+    assert "rank_peel_iterations_total" in reg.metric_names()
+    assert reg.gauge_value("rank_tile_size") >= 64
+    # hook detached: no further recording
+    before = reg.counter_value("rank_tile_sweeps_total")
+    non_dominated_rank(jnp.asarray(rng.random((40, 3)), jnp.float32))
+    assert reg.counter_value("rank_tile_sweeps_total") == before
+
+
 @pytest.mark.parametrize("n,d", [(2, 2), (17, 2), (40, 4)])
 def test_crowding_matches_naive(n, d, rng):
     Y = rng.random((n, d))
@@ -270,6 +371,40 @@ def test_duplicate_mask(rng):
     assert got[10:].all()
 
 
+def test_duplicate_mask_chunk_invariant(rng):
+    """The row-chunked duplicate scan must be bitwise independent of the
+    chunk size — including non-divisible chunks, masks, and NaN rows."""
+    X = rng.random((53, 4)).astype(np.float32)
+    X[11] = X[3]
+    X[29] = X[3]
+    X[40, 2] = np.nan
+    mask = jnp.asarray(rng.random(53) > 0.2)
+    for m in (None, mask):
+        base = np.asarray(duplicate_mask(jnp.asarray(X), mask=m))
+        for chunk in (7, 16, 53, 64):
+            got = np.asarray(duplicate_mask(jnp.asarray(X), mask=m, chunk=chunk))
+            np.testing.assert_array_equal(got, base, err_msg=f"chunk {chunk}")
+    # ground truth on the unmasked case
+    unmasked = np.asarray(duplicate_mask(jnp.asarray(X)))
+    assert unmasked[11] and unmasked[29] and not unmasked[3]
+
+
+def test_pairwise_distances_chunk_invariant(rng):
+    from dmosopt_tpu.ops import pairwise_distances
+
+    X = rng.random((37, 5)).astype(np.float32)
+    Y = rng.random((21, 5)).astype(np.float32)
+    base = np.asarray(pairwise_distances(jnp.asarray(X), jnp.asarray(Y)))
+    for chunk in (4, 10, 37):
+        got = np.asarray(
+            pairwise_distances(jnp.asarray(X), jnp.asarray(Y), row_chunk=chunk)
+        )
+        # per-row dot products; only matmul tiling may vary with chunk
+        np.testing.assert_allclose(got, base, rtol=1e-6, atol=1e-7)
+    expect = np.sqrt(((X[:, None, :] - Y[None, :, :]) ** 2).sum(-1))
+    np.testing.assert_allclose(base, expect, rtol=1e-4, atol=1e-5)
+
+
 def test_rank_stop_count_prefix_exact(rng):
     """Early-stopped peeling: every front up to the covering cut matches
     the full ranking; leftovers carry the legal sentinel n-1."""
@@ -323,3 +458,24 @@ def test_agemoea_survival_matches_bruteforce_greedy(rng):
         selected[best] = True
     expect = np.where(maskn, expect, 0.0)
     np.testing.assert_allclose(crowd, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_agemoea_survival_column_path_matches_dense(monkeypatch, rng):
+    """Above `_DENSE_SURVIVAL_MAX` the AGE-MOEA survival score switches
+    to on-demand Minkowski columns (no (N, N) matrix); the two regimes
+    must agree to float tolerance — the dense regime stays bitwise
+    frozen for trajectory stability, the column regime unlocks 16k+
+    fronts."""
+    from dmosopt_tpu.optimizers import agemoea as A
+
+    N, d, nf = 64, 3, 40
+    y = jnp.asarray(rng.random((N, d)).astype(np.float32))
+    mask = jnp.asarray(np.arange(N) < nf)
+    ideal = jnp.min(jnp.where(mask[:, None], y, A._INF), axis=0)
+    dense = [np.asarray(v) for v in A._survival_score(y, mask, ideal)]
+    monkeypatch.setattr(A, "_DENSE_SURVIVAL_MAX", 8)  # force column path
+    cols = [np.asarray(v) for v in A._survival_score(y, mask, ideal)]
+    for a, b in zip(dense, cols):
+        finite = np.isfinite(a)
+        np.testing.assert_array_equal(finite, np.isfinite(b))
+        np.testing.assert_allclose(a[finite], b[finite], rtol=1e-4, atol=1e-5)

@@ -248,3 +248,68 @@ def test_cmaes_cholesky_update_invariants():
         np.testing.assert_allclose(
             Ainv2[b] @ A2[b], np.eye(n), rtol=1e-3, atol=2e-3
         )
+
+
+# ------------------------------------------------- front-fill survival
+
+
+def test_front_fill_single_computation(monkeypatch):
+    """front_fill_selection computes the ranking and the mid-front
+    crowding each AT MOST once per trace, and zero times when the caller
+    supplies them — the single-computation contract CMAES/TRS (and any
+    future consumer holding precomputed ranks) rely on."""
+    import dmosopt_tpu.optimizers.survival as sv
+
+    sv.front_fill_selection.clear_cache()  # count at trace time
+    rng = np.random.default_rng(7)
+    calls = {"rank": 0, "crowd": 0}
+    real_rank, real_crowd = sv.non_dominated_rank, sv.crowding_distance
+
+    def counting_rank(*a, **k):
+        calls["rank"] += 1
+        return real_rank(*a, **k)
+
+    def counting_crowd(*a, **k):
+        calls["crowd"] += 1
+        return real_crowd(*a, **k)
+
+    monkeypatch.setattr(sv, "non_dominated_rank", counting_rank)
+    monkeypatch.setattr(sv, "crowding_distance", counting_crowd)
+
+    y = jnp.asarray(rng.random((60, 3)), jnp.float32)
+    sel, chosen, rank, crowd = sv.front_fill_selection(y, 24)
+    assert calls == {"rank": 1, "crowd": 1}
+    assert int(chosen.sum()) == 24 and sel.shape == (24,)
+
+    # supplying both skips every recompute and reproduces the selection
+    sel2, chosen2, rank2, crowd2 = sv.front_fill_selection(
+        y, 24, rank=rank, crowding=crowd
+    )
+    assert calls == {"rank": 1, "crowd": 1}
+    np.testing.assert_array_equal(np.asarray(sel), np.asarray(sel2))
+    np.testing.assert_array_equal(np.asarray(rank), np.asarray(rank2))
+    np.testing.assert_array_equal(np.asarray(crowd), np.asarray(crowd2))
+
+
+def test_front_fill_matches_rank_order():
+    """Selected set = the best `popsize` by (rank, -mid-front crowding):
+    every fully-fitting front is taken whole and only the straddling
+    front is crowding-filtered."""
+    from dmosopt_tpu.ops.dominance import _rank_matrix_peel
+    from dmosopt_tpu.optimizers.survival import front_fill_selection
+
+    rng = np.random.default_rng(3)
+    y = rng.random((80, 4)).astype(np.float32)
+    popsize = 30
+    sel, chosen, rank, crowd = front_fill_selection(jnp.asarray(y), popsize)
+    full = np.asarray(_rank_matrix_peel(jnp.asarray(y)))
+    chosen = np.asarray(chosen)
+    # fronts fully below the cut are entirely chosen; fronts fully above
+    # entirely unchosen
+    counts = np.cumsum(np.bincount(full, minlength=80))
+    for r in range(full.max() + 1):
+        members = full == r
+        if counts[r] <= popsize:
+            assert chosen[members].all()
+        elif (counts[r - 1] if r else 0) >= popsize:
+            assert not chosen[members].any()
