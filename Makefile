@@ -27,6 +27,11 @@ bench:
 bench-rank:
 	env DMOSOPT_BENCH_ONLY=rank_throughput python bench.py
 
+# the surrogate-refit config alone (warm-vs-cold GP train wall over
+# growing archives + end-to-end zdt1 under surrogate_refit="warm")
+bench-gp:
+	env DMOSOPT_BENCH_ONLY=gp_refit python bench.py
+
 # Warm .jax_bench_cache with the EXACT programs the round-end bench
 # compiles: one full bench pass, JSON line discarded. Run AFTER the last
 # code commit — any change to optimizer state layouts or jitted program

@@ -175,6 +175,7 @@ class DistOptimizer:
         optimizer_name="nsga2", optimizer_kwargs=None,
         surrogate_method_name="gpr", surrogate_method_kwargs=None,
         surrogate_custom_training=None, surrogate_custom_training_kwargs=None,
+        surrogate_refit=None,
         optimize_mean_variance=False,
         sensitivity_method_name=None, sensitivity_method_kwargs=None,
         feasibility_method_name=None, feasibility_method_kwargs=None,
@@ -214,6 +215,19 @@ class DistOptimizer:
             ``quorum_fraction``, ``eval_timeout``, ``eval_retries``,
             ``on_eval_failure``, ``jax_eval_chunks`` — see
             docs/parallel.md.
+          surrogate_refit: cross-epoch surrogate-reuse mode — ``"cold"``
+            (default: every epoch refits the GP from scratch, unchanged
+            behavior) or ``"warm"`` (warm-started refits from the
+            previous epoch's hyperparameters, rank-k Cholesky posterior
+            updates for appended rows once hyperparameters stabilize,
+            restart pruning with periodic full-restart audit fits).
+            Also accepts a dict of
+            `dmosopt_tpu.models.refit.SurrogateRefitConfig` kwargs
+            (``mode`` — required, ``hyper_tol``, ``amp_tol``,
+            ``rank_update_after``, ``prune_after``, ``pruned_starts``,
+            ``audit_every``, ``warm_iter_cap``) or a ready-made config
+            — see docs/surrogates.md. Warm state is persisted with the
+            checkpoint so a resumed run stays warm.
           telemetry: None/True for the on-by-default metrics + event log,
             False for none at all (zero telemetry calls on the hot
             path), a dict of `dmosopt_tpu.telemetry.Telemetry` kwargs
@@ -240,6 +254,7 @@ class DistOptimizer:
             surrogate_method_name=surrogate_method_name,
             surrogate_custom_training=surrogate_custom_training,
             surrogate_custom_training_kwargs=surrogate_custom_training_kwargs,
+            surrogate_refit=surrogate_refit,
             sensitivity_method_name=sensitivity_method_name,
             optimize_mean_variance=optimize_mean_variance,
             feasibility_method_name=feasibility_method_name,
@@ -612,12 +627,58 @@ class DistOptimizer:
             c = np.vstack([e.constraints for e in evals])
         return (epochs, x, y, f, c)
 
+    def _restored_refit_state(self, problem_id):
+        """Checkpointed surrogate warm state for a problem (None on a
+        fresh run, with `surrogate_refit="cold"`, or when the checkpoint
+        predates the refit engine) — seeds the strategy's refit
+        controller so a restored run's first fit warm-starts."""
+        if (
+            not self._resuming
+            or self.surrogate_refit is None
+            or self.file_path is None
+        ):
+            return None
+        from dmosopt_tpu.storage import load_refit_state_from_h5
+
+        try:
+            return load_refit_state_from_h5(
+                self.file_path, self.opt_id, problem_id
+            )
+        except Exception as e:
+            self.logger.warning(
+                f"could not restore surrogate refit state for problem "
+                f"{problem_id}: {e}"
+            )
+            return None
+
+    def save_refit_state(self, problem_id):
+        """Persist one problem's surrogate warm state (hyperparameters
+        + schedule counters) so a resumed run stays warm; overwrites the
+        previous epoch's snapshot (latest wins)."""
+        if not _is_primary_process():
+            return
+        ctrl = getattr(
+            self.optimizer_dict[problem_id], "refit_controller", None
+        )
+        if ctrl is None:
+            return
+        state = ctrl.export_state()
+        if state is None:
+            return
+        from dmosopt_tpu.storage import save_refit_state_to_h5
+
+        self._submit_write(
+            save_refit_state_to_h5,
+            self.opt_id, problem_id, state, self.file_path, self.logger,
+        )
+
     # driver attributes forwarded verbatim to every per-problem strategy
     _STRATEGY_FIELDS = (
         "resample_fraction", "population_size", "num_generations",
         "initial_maxiter", "initial_method", "distance_metric",
         "surrogate_method_name", "surrogate_method_kwargs",
         "surrogate_custom_training", "surrogate_custom_training_kwargs",
+        "surrogate_refit",
         "sensitivity_method_name", "sensitivity_method_kwargs",
         "optimizer_name", "optimizer_kwargs",
         "feasibility_method_name", "feasibility_method_kwargs",
@@ -660,6 +721,7 @@ class DistOptimizer:
                 # epoch so a resumed run's summary keeps it (epoch-0
                 # events are pruned once set_epoch advances past them)
                 xinit_epoch=self.start_epoch,
+                surrogate_refit_state=self._restored_refit_state(problem_id),
                 **spec,
             )
             self.storage_dict[problem_id] = []
@@ -1265,6 +1327,7 @@ class DistOptimizer:
         if self.save:
             for problem_id in self.problem_ids:
                 self.save_stats(problem_id, epoch)
+                self.save_refit_state(problem_id)
 
         if tel:
             tel.inc("epochs_total")

@@ -585,6 +585,8 @@ def train(
     file_path=None,
     mesh=None,
     info: Optional[Dict[str, Any]] = None,
+    surrogate_refit=None,
+    telemetry=None,
 ):
     """Fit the objective surrogate on feasible, deduplicated data
     (reference: dmosopt/MOASMO.py:473-532). A `mesh` is forwarded to
@@ -595,6 +597,15 @@ def train(
     (n_train, duplicates_removed, feasible_fraction, routed surrogate
     name) plus the fitted model's loss/step summary — the fields the
     telemetry `train` phase event carries.
+
+    `surrogate_refit` is a per-problem
+    `dmosopt_tpu.models.refit.SurrogateRefitController` (or None — the
+    default, taking the unchanged cold constructor path). The
+    controller decides, per epoch, whether to warm-start the refit from
+    the previous epoch's hyperparameters, extend the cached Cholesky
+    posterior by a rank-k update for the appended rows, or run a
+    full-restart audit fit — see docs/surrogates.md. `telemetry` feeds
+    its refit-path counters and events.
 
     Dense-kernel surrogate names (gpr/egp/megp/mdgp/mdspp, plus vgp
     whose inducing set is the full training set) are rerouted
@@ -657,10 +668,24 @@ def train(
             if "__init__" in c.__dict__
         ):
             kwargs["mesh"] = mesh
-    sm = cls(
-        x, y, nInput, nOutput, xlb, xub, **kwargs, logger=logger,
-        return_mean_variance=surrogate_return_mean_variance,
-    )
+    def builder(**overrides):
+        return cls(
+            x, y, nInput, nOutput, xlb, xub, **{**kwargs, **overrides},
+            logger=logger,
+            return_mean_variance=surrogate_return_mean_variance,
+        )
+
+    if surrogate_refit is not None and surrogate_refit.applies(cls):
+        sm = surrogate_refit.fit(
+            builder, x, y,
+            nan=kwargs.get("nan", "remove"),
+            top_k=kwargs.get("top_k"),
+            telemetry=telemetry, info=info,
+        )
+    else:
+        if surrogate_refit is not None:
+            surrogate_refit.note_unsupported(cls)
+        sm = builder()
     if info is not None:
         info["n_train"] = int(x.shape[0])
         info["surrogate"] = (
@@ -741,6 +766,7 @@ def epoch(
     surrogate_method_kwargs: Optional[Dict[str, Any]] = None,
     surrogate_custom_training=None,
     surrogate_custom_training_kwargs=None,
+    surrogate_refit=None,
     sensitivity_method_name=None,
     sensitivity_method_kwargs: Optional[Dict[str, Any]] = None,
     optimize_mean_variance: bool = False,
@@ -839,7 +865,8 @@ def epoch(
                 surrogate_method_kwargs=surrogate_method_kwargs,
                 surrogate_return_mean_variance=optimize_mean_variance,
                 logger=logger, file_path=file_path, mesh=mesh,
-                info=ph,
+                info=ph, surrogate_refit=surrogate_refit,
+                telemetry=telemetry,
             )
 
     if sensitivity_method_name is not None and mdl.sensitivity is None:

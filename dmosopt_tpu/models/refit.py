@@ -1,0 +1,563 @@
+"""Cross-epoch surrogate reuse: warm-started refits, rank-k posterior
+updates, and restart pruning.
+
+The MO-ASMO epoch loop refits every per-objective GP from scratch each
+epoch even though the training set only grew by one resample batch and
+the hyperparameters barely move between epochs — on the CPU bench the
+warm GP fit is roughly half the epoch wall of the `zdt*_agemoea_gpr`
+configs. GPRat (arXiv:2505.00136) and GPU-resident asynchronous GPR
+pipelines keep the factorization resident and update it incrementally
+instead of refactorizing; this module brings that discipline to the
+surrogate layer.
+
+`SurrogateRefitController` is a small host-side state machine owned by
+one `DistOptStrategy` (one per problem id) and invoked from
+`moasmo.train()`. Per fit it picks one of four paths:
+
+- ``cold``   — the unchanged from-scratch multi-restart fit (first fit,
+  unsupported surrogate classes, or ``mode="cold"`` which bypasses the
+  controller entirely and stays bitwise-identical to today).
+- ``audit``  — a periodic full-restart cold fit (every ``audit_every``
+  fits) that re-opens the global hyperparameter search so a warm
+  trajectory cannot lock into a local optimum unchallenged.
+- ``warm``   — `fit_gp_batch` with restart slot 0 pinned to the
+  previous epoch's converged hyperparameters and the remaining slots
+  jittered around them; the existing in-graph convergence stop
+  (`_scan_with_convergence`) then typically exits within the first
+  chunk or two. Once the warm slot has won ``prune_after`` consecutive
+  fits, the cold restarts are pruned to ``pruned_starts`` slots.
+- ``rank``   — when the hyperparameters have been stable (log-space
+  movement below ``hyper_tol``) for ``rank_update_after`` consecutive
+  refits and the new training set is an append-only extension of the
+  previous one, skip the Adam loop entirely: extend the cached
+  `GPFit.L`/`alpha` for the k appended rows with a blocked rank-k
+  Cholesky update (O(N²k) vs the O(N³) refactorization,
+  `gp.extend_cholesky_rank_k`). An append that crosses the padding
+  bucket boundary re-pads and falls back to a fixed-hyperparameter
+  refactorization (`gp.posterior_from_params`) — still no Adam.
+
+The speculative pipeline's straggler-reconciliation path composes with
+the ``rank`` path for free: stragglers land as appended archive rows at
+the next drain, so a stable surrogate absorbs them (plus the resample
+batch) through the same rank-k extension.
+
+State is host-small (per-objective hyperparameter vectors plus one
+reference to the previous fitted model, whose `(d, P, P)` factor stays
+device-resident anyway) and exports to a JSON-able dict so resumed runs
+warm-start their first refit from the checkpoint
+(`export_state`/`seed_state`; a restored run has no cached factor, so
+its first fit is a warm refit, not a rank update).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+#: refit modes accepted by the driver's ``surrogate_refit`` knob
+REFIT_MODES = ("cold", "warm")
+
+
+class SurrogateRefitConfig:
+    """Resolved form of the ``surrogate_refit`` parameter.
+
+    mode: ``"cold"`` (default — from-scratch refits, bitwise-identical
+        to the pre-refit behavior) or ``"warm"`` (the reuse engine).
+    hyper_tol: max log-space movement |log(θ'/θ)| of the
+        posterior-MEAN-shaping hyperparameters — lengthscales and the
+        effective-noise-to-amplitude ratio — below which a refit counts
+        as "stable" (rank-update eligible). With near-zero fitted noise
+        the mean is invariant to the amplitude (it cancels in
+        Kₛᵀ(amp·C + σI)⁻¹y as σ/amp → the relative jitter floor), so
+        amp is judged separately:
+    amp_tol: log-space amplitude movement tolerance (looser — amp
+        drift only rescales the posterior VARIANCE; it shrinks
+        systematically as the training set grows).
+    rank_update_after: consecutive stable refits required before the
+        Adam loop is skipped in favor of rank-k posterior updates.
+    prune_after: consecutive fits the warm slot must win before the
+        cold restarts are dropped.
+    pruned_starts: restart count once pruned (warm slot + jitters).
+    audit_every: every N-th fit runs a full-restart cold "audit" fit
+        (resets pruning and stability, escapes local optima, and
+        bounds how long a rank-updated posterior can drift unchecked).
+    warm_iter_cap: fraction of the cold ``n_iter`` budget a warm refit
+        may run (the adaptive step budget — warm fits lean on the
+        in-graph convergence stop and rarely need more; None disables
+        the cap).
+    """
+
+    __slots__ = (
+        "mode", "hyper_tol", "amp_tol", "rank_update_after", "prune_after",
+        "pruned_starts", "audit_every", "warm_iter_cap",
+    )
+
+    def __init__(
+        self,
+        mode: str = "cold",
+        hyper_tol: float = 0.1,
+        amp_tol: float = 0.7,
+        rank_update_after: int = 1,
+        prune_after: int = 2,
+        pruned_starts: int = 2,
+        audit_every: int = 5,
+        warm_iter_cap: Optional[float] = 0.25,
+    ):
+        if mode not in REFIT_MODES:
+            raise ValueError(
+                f"surrogate_refit mode {mode!r} not in {REFIT_MODES}"
+            )
+        if not (hyper_tol > 0.0):
+            raise ValueError(f"hyper_tol must be > 0; got {hyper_tol}")
+        if not (amp_tol > 0.0):
+            raise ValueError(f"amp_tol must be > 0; got {amp_tol}")
+        if rank_update_after < 0:
+            raise ValueError("rank_update_after must be >= 0")
+        if prune_after < 0:
+            raise ValueError("prune_after must be >= 0")
+        if pruned_starts < 1:
+            raise ValueError("pruned_starts must be >= 1")
+        if audit_every < 2:
+            raise ValueError("audit_every must be >= 2")
+        if warm_iter_cap is not None and not (0.0 < warm_iter_cap <= 1.0):
+            raise ValueError(
+                f"warm_iter_cap must be in (0, 1] or None; got {warm_iter_cap}"
+            )
+        self.mode = mode
+        self.hyper_tol = float(hyper_tol)
+        self.amp_tol = float(amp_tol)
+        self.rank_update_after = int(rank_update_after)
+        self.prune_after = int(prune_after)
+        self.pruned_starts = int(pruned_starts)
+        self.audit_every = int(audit_every)
+        self.warm_iter_cap = (
+            float(warm_iter_cap) if warm_iter_cap is not None else None
+        )
+
+    @classmethod
+    def from_spec(cls, spec) -> "SurrogateRefitConfig":
+        """None -> cold; a mode string; a dict of constructor kwargs
+        (``"mode"`` required — a tuning dict that silently resolved to
+        the cold default would disable the engine without a trace); or
+        a ready-made config."""
+        if spec is None:
+            return cls()
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, str):
+            return cls(mode=spec)
+        if isinstance(spec, dict):
+            if "mode" not in spec:
+                raise ValueError(
+                    "surrogate_refit dict must name 'mode' explicitly "
+                    "(e.g. {'mode': 'warm', ...}); without it the tuning "
+                    "knobs would silently apply to the cold default"
+                )
+            return cls(**spec)
+        raise TypeError(
+            f"surrogate_refit must be None, str, dict, or "
+            f"SurrogateRefitConfig; got {type(spec)!r}"
+        )
+
+
+def _hyper_movement(
+    a: Dict[str, np.ndarray], b: Dict[str, np.ndarray]
+) -> Dict[str, float]:
+    """Log-space movement split by what each hyperparameter does to the
+    posterior (scale-free: a lengthscale at 0.01 and an amplitude at
+    100 are judged by the same relative yardstick):
+
+    - ``mean``: max over lengthscales and the effective-noise-to-
+      amplitude ratio — the quantities the posterior MEAN depends on.
+      Noise enters as the EFFECTIVE diagonal over amp (see `_record`):
+      below the f32 jitter floor the raw noise wanders freely in
+      log-space without changing the kernel at all, and the amplitude
+      cancels out of the mean entirely when the ratio is held.
+    - ``amp``: amplitude alone — it only rescales the posterior
+      variance, and shrinks systematically as N grows, so it gets its
+      own (looser) tolerance.
+    """
+    ratio_a = a["eff_noise"] / a["amp"]
+    ratio_b = b["eff_noise"] / b["amp"]
+    mean_mv = max(
+        float(np.max(np.abs(np.log(a["ls"]) - np.log(b["ls"])))),
+        float(np.max(np.abs(np.log(ratio_a) - np.log(ratio_b)))),
+    )
+    amp_mv = float(np.max(np.abs(np.log(a["amp"]) - np.log(b["amp"]))))
+    return {"mean": mean_mv, "amp": amp_mv}
+
+
+class SurrogateRefitController:
+    """Per-problem host state machine choosing the refit path each epoch
+    (see module docstring for the paths). One instance lives on a
+    `DistOptStrategy` and is threaded into every `moasmo.train()` call
+    for that problem."""
+
+    def __init__(self, config: SurrogateRefitConfig, logger=None,
+                 seed_state: Optional[dict] = None):
+        self.config = config
+        self.logger = logger
+        self._model = None  # previous fitted surrogate (device factor)
+        self._hyper: Optional[Dict[str, np.ndarray]] = None
+        self._y_mean = self._y_std = None
+        self._n_train = 0
+        self._n_iter_max = 0  # cold n_iter budget (steps-saved baseline)
+        self._stable = 0
+        self._warm_wins = 0
+        self._fits_since_audit = 0
+        self._unsupported_warned = False
+        self.last_path: Optional[str] = None
+        self.path_history: list = []
+        if seed_state:
+            self._seed(seed_state)
+
+    # ------------------------------------------------------- persistence
+
+    def _seed(self, state: dict):
+        """Adopt a checkpointed `export_state` dict: hyperparameters and
+        schedule counters only — the first fit after a resume is a warm
+        refit (no cached factor exists to rank-update)."""
+        try:
+            amp = np.asarray(state["amp"], dtype=np.float64)
+            noise = np.asarray(state["noise"], dtype=np.float64)
+            self._hyper = {
+                "amp": amp,
+                "ls": np.asarray(state["ls"], dtype=np.float64),
+                "noise": noise,
+                "eff_noise": (
+                    np.asarray(state["eff_noise"], dtype=np.float64)
+                    if "eff_noise" in state
+                    # pre-eff_noise checkpoint: f32-default floor
+                    else noise + 1e-6 + 1e-4 * amp
+                ),
+            }
+        except (KeyError, TypeError, ValueError):
+            if self.logger is not None:
+                self.logger.warning(
+                    "surrogate_refit: unusable checkpoint state; first "
+                    "fit will run cold"
+                )
+            self._hyper = None
+            return
+        self._stable = int(state.get("stable", 0))
+        self._warm_wins = int(state.get("warm_wins", 0))
+        self._fits_since_audit = int(state.get("fits_since_audit", 0))
+        self._n_train = int(state.get("n_train", 0))
+        self._n_iter_max = int(state.get("n_iter_max", 0))
+
+    @property
+    def has_state(self) -> bool:
+        return self._hyper is not None
+
+    def export_state(self) -> Optional[dict]:
+        """JSON-able warm state for the checkpoint (None before the
+        first fit)."""
+        if self._hyper is None:
+            return None
+        return {
+            "amp": self._hyper["amp"].tolist(),
+            "ls": self._hyper["ls"].tolist(),
+            "noise": self._hyper["noise"].tolist(),
+            "eff_noise": self._hyper["eff_noise"].tolist(),
+            "stable": self._stable,
+            "warm_wins": self._warm_wins,
+            "fits_since_audit": self._fits_since_audit,
+            "n_train": self._n_train,
+            "n_iter_max": self._n_iter_max,
+        }
+
+    # ---------------------------------------------------------- plumbing
+
+    def applies(self, cls) -> bool:
+        """The reuse engine covers the exact-GP family fitted through
+        `fit_gp_batch` (gpr/egp and subclasses); anything else — the
+        shared-kernel MEGP, SVGP reroutes, user classes — takes the
+        plain cold constructor."""
+        from dmosopt_tpu.models.gp import GPR_Matern
+
+        return isinstance(cls, type) and issubclass(cls, GPR_Matern)
+
+    def note_unsupported(self, cls):
+        if not self._unsupported_warned and self.logger is not None:
+            self.logger.info(
+                f"surrogate_refit: {getattr(cls, '__name__', cls)!r} is "
+                f"outside the exact-GP warm-refit family; fitting cold"
+            )
+        self._unsupported_warned = True
+
+    def _record(self, sm):
+        """Snapshot the converged fit: host hyperparameter vectors (for
+        warm starts and movement tracking) plus the model itself (its
+        resident factor feeds the next rank-k extension)."""
+        from dmosopt_tpu.models import gp
+
+        fit = sm.fit
+        self._model = sm
+        amp = np.asarray(fit.amp, dtype=np.float64)
+        noise = np.asarray(fit.noise, dtype=np.float64)
+        rel_jitter = getattr(sm, "_rel_jitter", None)
+        if rel_jitter is None:
+            rel_jitter = gp._default_rel_jitter(fit.X.dtype)
+        self._hyper = {
+            "amp": amp,
+            "ls": np.asarray(fit.ls, dtype=np.float64),
+            "noise": noise,
+            # the diagonal the kernel actually carries (see
+            # gp._regularized_kernel) — what movement is judged on
+            "eff_noise": noise + gp._JITTER + rel_jitter * amp,
+        }
+        self._y_mean = np.asarray(fit.y_mean, dtype=np.float64)
+        self._y_std = np.asarray(fit.y_std, dtype=np.float64)
+        self._n_train = int(np.sum(np.asarray(fit.train_mask) > 0.0))
+        # the steps-saved baseline is the COLD budget: warm fits report
+        # their capped n_iter, which must not shrink the baseline
+        self._n_iter_max = max(
+            self._n_iter_max,
+            int(
+                (getattr(sm, "fit_info", None) or {}).get("n_iter_max", 0)
+            ),
+        )
+
+    def _emit(self, telemetry, info, path, **fields):
+        self.last_path = path
+        self.path_history.append(path)
+        if info is not None:
+            info["refit_path"] = path
+        if telemetry:
+            telemetry.event("surrogate_refit", path=path, **fields)
+
+    # ------------------------------------------------------------- paths
+
+    def fit(self, builder, xin, yin, *, nan="remove", top_k=None,
+            telemetry=None, info=None):
+        """Fit (or update) the surrogate for this epoch's training set.
+
+        `builder(**overrides)` constructs the surrogate class with the
+        epoch's resolved kwargs; `xin`/`yin` are the deduplicated,
+        feasibility-filtered training rows `train()` would hand the
+        constructor (the rank path re-runs the same normalization
+        pipeline on them with the cached y statistics).
+        """
+        cfg = self.config
+        if self._hyper is None:
+            sm = builder()
+            self._record(sm)
+            self._fits_since_audit = 0
+            self._emit(telemetry, info, "cold",
+                       n_train=self._n_train,
+                       n_steps=sm.fit_info.get("n_steps"))
+            return sm
+
+        if self._fits_since_audit >= cfg.audit_every:
+            return self._fit_audit(builder, telemetry, info)
+
+        if self._stable >= cfg.rank_update_after and self._model is not None:
+            sm = self._try_rank_update(
+                xin, yin, nan, top_k, telemetry, info
+            )
+            if sm is not None:
+                return sm
+            # ineligible append (reordered/filtered training set, class
+            # change) — fall through to a warm refit
+
+        return self._fit_warm(builder, telemetry, info)
+
+    def _fit_audit(self, builder, telemetry, info):
+        """Full-restart cold fit re-opening the global search; resets
+        the stability/pruning schedule so rank updates must re-earn
+        their eligibility against the audited optimum."""
+        prev_hyper = self._hyper
+        sm = builder()
+        self._record(sm)
+        movement = _hyper_movement(prev_hyper, self._hyper)
+        self._fits_since_audit = 0
+        self._stable = 0
+        self._warm_wins = 0
+        if telemetry:
+            telemetry.inc("gp_refit_audits_total")
+        self._emit(telemetry, info, "audit",
+                   n_train=self._n_train,
+                   movement=round(movement["mean"], 6),
+                   movement_amp=round(movement["amp"], 6),
+                   n_steps=sm.fit_info.get("n_steps"))
+        if self.logger is not None:
+            self.logger.info(
+                f"surrogate_refit: audit fit moved hyperparameters by "
+                f"{movement['mean']:.4f} (mean-shaping) / "
+                f"{movement['amp']:.4f} (amp), log-space max"
+            )
+        return sm
+
+    def _fit_warm(self, builder, telemetry, info):
+        cfg = self.config
+        prev_hyper = self._hyper
+        pruned = self._warm_wins >= cfg.prune_after
+        overrides: Dict[str, Any] = {
+            "warm_start": (
+                prev_hyper["amp"], prev_hyper["ls"], prev_hyper["noise"]
+            )
+        }
+        if pruned:
+            overrides["n_starts"] = cfg.pruned_starts
+        if cfg.warm_iter_cap is not None and self._n_iter_max > 0:
+            # the adaptive step budget: a warm fit leans on the
+            # in-graph convergence stop; the cap bounds the worst case
+            overrides["n_iter"] = max(
+                1, int(round(self._n_iter_max * cfg.warm_iter_cap))
+            )
+        try:
+            sm = builder(**overrides)
+        except ValueError as e:
+            # e.g. a resumed run whose surrogate config changed shape
+            # (anisotropic flip): the cached state is unusable — refit
+            # cold and start the schedule over
+            if self.logger is not None:
+                self.logger.warning(
+                    f"surrogate_refit: warm state unusable ({e}); "
+                    f"refitting cold"
+                )
+            sm = builder()
+            self._record(sm)
+            self._fits_since_audit = 0
+            self._stable = 0
+            self._warm_wins = 0
+            self._emit(telemetry, info, "cold",
+                       n_train=self._n_train,
+                       n_steps=sm.fit_info.get("n_steps"))
+            return sm
+        base_iter = self._n_iter_max
+        self._record(sm)
+        self._fits_since_audit += 1
+
+        movement = _hyper_movement(prev_hyper, self._hyper)
+        stable = (
+            movement["mean"] <= cfg.hyper_tol
+            and movement["amp"] <= cfg.amp_tol
+        )
+        self._stable = self._stable + 1 if stable else 0
+        best_start = sm.fit.best_start
+        warm_won = best_start is not None and bool(
+            np.all(np.asarray(best_start) == 0)
+        )
+        self._warm_wins = self._warm_wins + 1 if warm_won else 0
+
+        n_steps = int(sm.fit_info.get("n_steps", 0))
+        if telemetry:
+            telemetry.inc("gp_warm_starts_total")
+            telemetry.inc(
+                "gp_refit_steps_saved_total", max(base_iter - n_steps, 0)
+            )
+        self._emit(
+            telemetry, info, "warm",
+            n_train=self._n_train,
+            movement=round(movement["mean"], 6),
+            movement_amp=round(movement["amp"], 6),
+            warm_won=warm_won, pruned=pruned, n_steps=n_steps,
+        )
+        return sm
+
+    def _try_rank_update(self, xin, yin, nan, top_k, telemetry, info):
+        """Extend the cached posterior for appended rows; None when the
+        new training set is not an append-only extension of the cached
+        one (the caller then warm-refits)."""
+        from dmosopt_tpu.models import gp
+
+        prev = self._model
+        cfg = self.config
+
+        class _Holder:  # _prepare_training_data writes bounds attrs here
+            pass
+
+        X, Yn, _, _ = gp._prepare_training_data(
+            _Holder(), xin, yin, prev.nInput, prev.nOutput,
+            prev.xlb, prev.xub, nan, top_k,
+            y_stats=(self._y_mean, self._y_std),
+        )
+        n_new, n_old = X.shape[0], self._n_train
+        if n_new < n_old:
+            return None
+        dt_np = np.asarray(prev.fit.X).dtype
+        X_cast = np.asarray(X, dtype=dt_np)
+        prev_X = np.asarray(prev.fit.X)
+        if not np.array_equal(X_cast[:n_old], prev_X[:n_old]):
+            return None  # rows were reordered or dropped — not an append
+        k = n_new - n_old
+        d = int(prev.nOutput)
+        n_iter_max = self._n_iter_max  # the cold budget, all of it saved
+        if k == 0:
+            # dedupe swallowed the whole batch: the cached posterior is
+            # already exact for this training set
+            self._fits_since_audit += 1
+            if telemetry:
+                telemetry.inc("gp_rank_updates_total")
+                telemetry.inc("gp_refit_steps_saved_total", n_iter_max)
+            self._emit(telemetry, info, "rank",
+                       n_train=n_old, rank_rows=0)
+            return prev
+
+        import jax
+        import jax.numpy as jnp
+
+        P = prev_X.shape[0]
+        rel_jitter = prev._rel_jitter
+        if rel_jitter is None:
+            rel_jitter = gp._default_rel_jitter(prev.fit.X.dtype)
+        if n_new <= P:
+            # in-bucket append: blocked rank-k update of the cached factor
+            X_pad = prev_X.copy()
+            X_pad[n_old:n_new] = X_cast[n_old:n_new]
+            mask = (np.arange(P) < n_new).astype(dt_np)
+            Yn_pad = np.zeros((P, d), dtype=dt_np)
+            Yn_pad[:n_new] = np.asarray(Yn, dtype=dt_np)
+            L, alpha, nmll = gp.extend_cholesky_rank_k(
+                prev.fit.L, jnp.asarray(X_pad), jnp.asarray(mask),
+                jnp.asarray(Yn_pad), prev.fit.amp, prev.fit.ls,
+                prev.fit.noise, kernel=prev.kernel,
+                n_old=n_old, n_new=n_new, rel_jitter=rel_jitter,
+            )
+            path = "rank"
+            fit = prev.fit._replace(
+                X=jnp.asarray(X_pad), L=L, alpha=alpha, nmll=nmll,
+                train_mask=jnp.asarray(mask),
+                n_steps=jnp.asarray(0, jnp.int32),
+            )
+        else:
+            # bucket boundary crossed: re-pad and refactorize at the
+            # fixed hyperparameters (no Adam — still no refit)
+            X_pad, Yn_pad, mask = gp._pad_to_bucket(
+                X_cast, np.asarray(Yn, dtype=dt_np)
+            )
+            L, alpha, nmll = gp.posterior_from_params(
+                jnp.asarray(X_pad), jnp.asarray(Yn_pad),
+                jnp.asarray(mask.astype(dt_np)),
+                prev.fit.amp, prev.fit.ls, prev.fit.noise,
+                kernel=prev.kernel, rel_jitter=rel_jitter,
+            )
+            path = "rank_refactor"
+            fit = prev.fit._replace(
+                X=jnp.asarray(X_pad), L=L, alpha=alpha, nmll=nmll,
+                train_mask=jnp.asarray(mask.astype(dt_np)),
+                n_steps=jnp.asarray(0, jnp.int32),
+            )
+
+        nmll_np = np.asarray(nmll, dtype=np.float64)
+        fit_info = {
+            "loss": float(np.mean(nmll_np)),
+            "nmll_per_objective": [float(v) for v in nmll_np],
+            "n_steps": 0,
+            "n_iter_max": n_iter_max,
+            "early_stopped": True,
+            "refit_path": path,
+            "rank_rows": int(k),
+        }
+        sm = gp.clone_with_fit(prev, fit, fit_info)
+        self._model = sm
+        self._n_train = n_new
+        self._fits_since_audit += 1
+        if telemetry:
+            telemetry.inc("gp_rank_updates_total")
+            telemetry.inc("gp_rank_update_rows_total", k)
+            telemetry.inc("gp_refit_steps_saved_total", n_iter_max)
+        self._emit(telemetry, info, path, n_train=n_new, rank_rows=int(k))
+        return sm

@@ -19,6 +19,14 @@ same overlap from two smaller pieces:
   closures. One thread + submission-order execution means the HDF5 file
   sees exactly the write sequence the serial loop would issue — the
   overlap changes *when* the driver blocks, never *what* is written.
+
+Speculative mode composes with the surrogate-reuse engine
+(``surrogate_refit="warm"``, see `dmosopt_tpu.models.refit`): the
+stragglers a quorum return leaves in flight reconcile as rows APPENDED
+to the archive at the next drain, so a stable surrogate absorbs them —
+together with the next resample batch — through the O(N²k) rank-k
+Cholesky posterior update instead of triggering a from-scratch refit of
+the model that was fitted at quorum.
 """
 
 from __future__ import annotations

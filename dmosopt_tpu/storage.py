@@ -401,6 +401,29 @@ def load_telemetry_from_h5(fpath, opt_id) -> Dict[int, Dict]:
         return {int(k): json.loads(grp.attrs[k]) for k in grp.attrs}
 
 
+def save_refit_state_to_h5(opt_id, problem_id, state, fpath, logger=None):
+    """Store one problem's surrogate warm-refit state (the JSON-able
+    dict from `SurrogateRefitController.export_state`) under
+    `/{opt_id}/{problem_id}/surrogate_refit`. One attribute, overwritten
+    per epoch — only the latest converged hyperparameters matter for
+    warm-starting a resumed run."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        grp = h5_get_group(h5, f"{opt_id}/{problem_id}")
+        _json_attr(grp, "surrogate_refit", state)
+
+
+def load_refit_state_from_h5(fpath, opt_id, problem_id) -> Optional[Dict]:
+    """The stored warm-refit state dict for a problem, or None when the
+    checkpoint has none (fresh run, cold mode, pre-refit checkpoint)."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "r") as h5:
+        key = f"{opt_id}/{problem_id}"
+        if key not in h5:
+            return None
+        return _load_json_attr(h5[key], "surrogate_refit")
+
+
 def save_stats_to_h5(opt_id, problem_id, epoch, fpath, logger=None, stats=None):
     """Store runtime stats per epoch (reference: dmosopt/dmosopt.py:2243-2282)."""
     h5py = _require_h5py()

@@ -74,6 +74,8 @@ class DistOptStrategy:
         surrogate_method_kwargs: Optional[Dict] = None,
         surrogate_custom_training: Optional[str] = None,
         surrogate_custom_training_kwargs: Optional[Dict] = None,
+        surrogate_refit=None,
+        surrogate_refit_state: Optional[Dict] = None,
         sensitivity_method_name: Optional[str] = None,
         sensitivity_method_kwargs: Optional[Dict] = None,
         feasibility_method_name=None,
@@ -105,6 +107,22 @@ class DistOptStrategy:
         )
         self.feasibility_method_kwargs = feasibility_method_kwargs or {}
         self.surrogate_method_kwargs = surrogate_method_kwargs or {}
+        # cross-epoch surrogate reuse: one controller per problem, its
+        # state persisting across this strategy's epochs (and, via
+        # surrogate_refit_state, across checkpoint resumes). mode="cold"
+        # (the default) keeps the controller out of the loop entirely.
+        self.surrogate_refit = surrogate_refit
+        self.refit_controller = None
+        from dmosopt_tpu.models.refit import (
+            SurrogateRefitConfig,
+            SurrogateRefitController,
+        )
+
+        refit_cfg = SurrogateRefitConfig.from_spec(surrogate_refit)
+        if refit_cfg.mode != "cold":
+            self.refit_controller = SurrogateRefitController(
+                refit_cfg, logger=logger, seed_state=surrogate_refit_state
+            )
         self.sensitivity_method_kwargs = sensitivity_method_kwargs or {}
         self.optimizer_name = as_tuple(optimizer_name)
         self.optimizer_kwargs = as_tuple(
@@ -359,6 +377,9 @@ class DistOptStrategy:
             pop=self.population_size,
             optimizer_name=optimizer_name,
             optimizer_kwargs=optimizer_kwargs,
+            # the epoch threads the CONTROLLER (cross-epoch state), not
+            # the config spec, into moasmo.train
+            surrogate_refit=self.refit_controller,
         )
         return spec
 

@@ -1,0 +1,503 @@
+"""Cross-epoch surrogate-reuse tests: rank-k Cholesky update parity,
+warm-start quality, controller scheduling (pruning, audits, bucket
+fallback), cold-mode bitwise regression, and checkpoint round-trip.
+
+Oracle pattern: the rank-k extension is pinned against the full masked
+refactorization at the SAME hyperparameters (`posterior_from_params`) —
+identical math in exact arithmetic, f32 reduction-order tolerance in
+practice. Cold mode is pinned BITWISE against the pre-refit
+constructor path.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dmosopt_tpu import moasmo
+from dmosopt_tpu.models import gp
+from dmosopt_tpu.models.gp import (
+    GPR_Matern,
+    extend_cholesky_rank_k,
+    gp_predict,
+    posterior_from_params,
+)
+from dmosopt_tpu.models.refit import (
+    SurrogateRefitConfig,
+    SurrogateRefitController,
+)
+
+
+def _objective(x):
+    return np.column_stack(
+        [np.sum(x**2, axis=1), np.sum((x - 0.5) ** 2, axis=1)]
+    )
+
+
+def _pool(n, dim=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(size=(n, dim))
+    return X, _objective(X)
+
+
+FAST = {"n_starts": 4, "n_iter": 80, "seed": 0}
+
+
+class _Telemetry:
+    """Minimal counter/event recorder standing in for the facade."""
+
+    def __init__(self):
+        self.counters = {}
+        self.events = []
+
+    def inc(self, name, value=1.0, **labels):
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+def _train(ctrl, X, Y, tel=None, dim=5, kwargs=FAST):
+    return moasmo.train(
+        dim, 2, np.zeros(dim), np.ones(dim), X, Y, None,
+        surrogate_method_kwargs=dict(kwargs),
+        surrogate_refit=ctrl, telemetry=tel,
+    )
+
+
+def _drive_to_rank(ctrl, X, Y, sizes, tel=None):
+    """Run one train() per size; returns the last model."""
+    sm = None
+    for n in sizes:
+        sm = _train(ctrl, X[:n], Y[:n], tel=tel)
+    return sm
+
+
+# ------------------------------------------------------------ rank parity
+
+
+@pytest.mark.parametrize("n0,k", [(70, 8), (100, 28)])
+def test_rank_update_parity_vs_refactorization(n0, k):
+    """An in-bucket rank-k append must reproduce the full masked
+    refactorization at the same hyperparameters: L bit-comparable up to
+    f32 reduction order, alpha/predictions to f32 tolerance. (70, 8)
+    appends into a partially padded 128 bucket; (100, 28) fills the
+    bucket to its exact edge (128 = no padded rows left)."""
+    dim = 5
+    X, Y = _pool(n0 + k, dim=dim)
+    base = GPR_Matern(
+        X[:n0], Y[:n0], dim, 2, np.zeros(dim), np.ones(dim), **FAST
+    )
+    fit = base.fit
+    P = fit.X.shape[0]
+    assert n0 + k <= P, "test shapes must stay inside the bucket"
+
+    # standardize the appended rows with the BASE fit's statistics
+    y_mean = np.asarray(fit.y_mean, np.float64)
+    y_std = np.asarray(fit.y_std, np.float64)
+    Xu = np.asarray(X, np.float64)  # bounds are the unit box already
+    Yn = (np.asarray(Y, np.float64) - y_mean) / y_std
+
+    X_pad = np.asarray(fit.X).copy()
+    X_pad[n0 : n0 + k] = Xu[n0 : n0 + k].astype(X_pad.dtype)
+    mask = (np.arange(P) < n0 + k).astype(X_pad.dtype)
+    Yn_pad = np.zeros((P, 2), X_pad.dtype)
+    Yn_pad[: n0 + k] = Yn.astype(X_pad.dtype)
+
+    L_up, a_up, nmll_up = extend_cholesky_rank_k(
+        fit.L, jnp.asarray(X_pad), jnp.asarray(mask), jnp.asarray(Yn_pad),
+        fit.amp, fit.ls, fit.noise, kernel="matern52",
+        n_old=n0, n_new=n0 + k, rel_jitter=base._rel_jitter,
+    )
+    L_full, a_full, nmll_full = posterior_from_params(
+        jnp.asarray(X_pad), jnp.asarray(Yn_pad), jnp.asarray(mask),
+        fit.amp, fit.ls, fit.noise, kernel="matern52",
+        rel_jitter=base._rel_jitter,
+    )
+
+    # f32 tolerance, scale-normalized: alpha = K^-1 y amplifies the
+    # Schur complement's reduction-order noise by the condition number,
+    # so it is judged against its own magnitude; the predictions below
+    # (the quantity consumers see) agree far tighter
+    def norm_diff(a, b):
+        a, b = np.asarray(a), np.asarray(b)
+        return float(np.max(np.abs(a - b)) / max(1.0, np.max(np.abs(b))))
+
+    assert norm_diff(L_up, L_full) < 1e-3
+    assert norm_diff(a_up, a_full) < 3e-2
+    np.testing.assert_allclose(
+        np.asarray(nmll_up), np.asarray(nmll_full), rtol=1e-3, atol=1e-2
+    )
+
+    # predictions through the updated fit match the refactorized ones
+    fit_up = fit._replace(
+        X=jnp.asarray(X_pad), L=L_up, alpha=a_up,
+        train_mask=jnp.asarray(mask),
+    )
+    fit_full = fit._replace(
+        X=jnp.asarray(X_pad), L=L_full, alpha=a_full,
+        train_mask=jnp.asarray(mask),
+    )
+    Xq = jnp.asarray(np.random.default_rng(3).uniform(size=(20, dim)), jnp.float32)
+    m1, v1 = gp_predict(fit_up, Xq)
+    m2, v2 = gp_predict(fit_full, Xq)
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), atol=2e-3)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=1e-4)
+
+
+def test_rank_update_unpadded_start():
+    """Appending to a fit whose training set exactly fills its bucket
+    (no padded rows at all in the masked sense: mask all-ones) is the
+    bucket-boundary case — the controller must fall back to the
+    refactorization path and still produce a posterior matching a
+    from-scratch one at the same hyperparameters."""
+    dim = 5
+    X, Y = _pool(200, dim=dim)
+    tel = _Telemetry()
+    # rank_update_after=0: rank-eligible right after the first fit
+    ctrl = SurrogateRefitController(
+        SurrogateRefitConfig("warm", rank_update_after=0, audit_every=10)
+    )
+    sm0 = _train(ctrl, X[:64], Y[:64], tel=tel)  # 64 = exact bucket, no padding
+    assert ctrl.path_history == ["cold"]
+    assert float(jnp.sum(sm0.fit.train_mask)) == 64.0
+
+    sm1 = _train(ctrl, X[:80], Y[:80], tel=tel)  # crosses into the 128 bucket
+    assert ctrl.path_history == ["cold", "rank_refactor"]
+    assert sm1.fit.X.shape[0] == 128
+
+    # oracle: same hyperparams, fresh refactorization
+    y_mean = np.asarray(sm0.fit.y_mean, np.float64)
+    y_std = np.asarray(sm0.fit.y_std, np.float64)
+    Yn = (Y[:80] - y_mean) / y_std
+    X_pad, Yn_pad, mask = gp._pad_to_bucket(
+        X[:80].astype(np.float32), Yn.astype(np.float32)
+    )
+    L, a, _ = posterior_from_params(
+        jnp.asarray(X_pad), jnp.asarray(Yn_pad), jnp.asarray(mask),
+        sm0.fit.amp, sm0.fit.ls, sm0.fit.noise, kernel="matern52",
+        rel_jitter=sm0._rel_jitter,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sm1.fit.L), np.asarray(L), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(sm1.fit.alpha), np.asarray(a), rtol=2e-3, atol=2e-3
+    )
+    assert tel.counters["gp_rank_update_rows_total"] == 16
+
+
+def test_rank_update_quality_tracks_full_fit():
+    """A surrogate grown by rank-k updates keeps predicting the
+    objective: MAE on held-out points stays comparable to a cold fit
+    of the full training set."""
+    dim = 5
+    X, Y = _pool(140, dim=dim, seed=4)
+    ctrl = SurrogateRefitController(
+        SurrogateRefitConfig("warm", rank_update_after=0, audit_every=50)
+    )
+    sm = _drive_to_rank(ctrl, X, Y, [100, 110, 120])
+    assert ctrl.path_history == ["cold", "rank", "rank"]
+    cold = GPR_Matern(
+        X[:120], Y[:120], dim, 2, np.zeros(dim), np.ones(dim), **FAST
+    )
+    Xq = X[120:]
+    mae_rank = np.abs(np.asarray(sm.predict(Xq)[0]) - Y[120:]).mean()
+    mae_cold = np.abs(np.asarray(cold.predict(Xq)[0]) - Y[120:]).mean()
+    assert mae_rank < max(2.0 * mae_cold, 0.05), (mae_rank, mae_cold)
+
+
+# ------------------------------------------------------- controller logic
+
+
+def test_controller_schedule_and_counters():
+    """cold first, warm until stable, rank once stable, audit on the
+    configured cadence — with the telemetry counters and events the
+    observability catalog documents."""
+    dim = 5
+    X, Y = _pool(130, dim=dim)
+    tel = _Telemetry()
+    ctrl = SurrogateRefitController(
+        SurrogateRefitConfig(
+            "warm", rank_update_after=0, audit_every=3, hyper_tol=0.1
+        )
+    )
+    sizes = [70, 78, 86, 94, 102]  # all inside the 128 bucket
+    _drive_to_rank(ctrl, X, Y, sizes, tel=tel)
+    # fit 0 cold (resets the audit clock); fits 1-3 rank (stable
+    # immediately with rank_update_after=0); fit 4 audits once
+    # fits_since_audit reaches audit_every=3
+    assert ctrl.path_history == ["cold", "rank", "rank", "rank", "audit"]
+    assert tel.counters["gp_rank_updates_total"] == 3
+    assert tel.counters["gp_rank_update_rows_total"] == 24
+    assert tel.counters["gp_refit_audits_total"] == 1
+    # every rank update banks the whole n_iter budget
+    assert tel.counters["gp_refit_steps_saved_total"] == 3 * FAST["n_iter"]
+    audit_events = [f for k, f in tel.events if k == "surrogate_refit"
+                    and f["path"] == "audit"]
+    assert len(audit_events) == 1 and "movement" in audit_events[0]
+
+
+def test_warm_start_pruning_and_steps_saved():
+    """Warm refits record warm-slot wins; after prune_after consecutive
+    wins the restart grid shrinks to pruned_starts (visible through the
+    fit event), and steps saved accumulate."""
+    dim = 5
+    X, Y = _pool(120, dim=dim)
+    tel = _Telemetry()
+    # rank disabled (huge threshold) so every epoch is a warm refit
+    ctrl = SurrogateRefitController(
+        SurrogateRefitConfig(
+            "warm", rank_update_after=99, prune_after=2, pruned_starts=2,
+            audit_every=99,
+        )
+    )
+    for n in (60, 70, 80, 90, 100):
+        _train(ctrl, X[:n], Y[:n], tel=tel)
+    warm_events = [f for k, f in tel.events if f.get("path") == "warm"]
+    assert len(warm_events) == 4
+    assert tel.counters["gp_warm_starts_total"] == 4
+    # smooth objective: the warm slot keeps winning, so later refits run
+    # pruned
+    if all(e["warm_won"] for e in warm_events[:2]):
+        assert warm_events[2]["pruned"] and warm_events[3]["pruned"]
+    assert tel.counters.get("gp_refit_steps_saved_total", 0) > 0
+
+
+def test_warm_fit_matches_cold_quality():
+    """A warm-started refit lands at (or below) the cold fit's NMLL —
+    reusing hyperparameters must never cost model quality."""
+    dim = 5
+    X, Y = _pool(110, dim=dim)
+    ctrl = SurrogateRefitController(
+        SurrogateRefitConfig("warm", rank_update_after=99, audit_every=99)
+    )
+    _train(ctrl, X[:70], Y[:70])
+    warm = _train(ctrl, X[:100], Y[:100])
+    cold = _train(None, X[:100], Y[:100])
+    warm_nmll = np.asarray(warm.fit.nmll)
+    cold_nmll = np.asarray(cold.fit.nmll)
+    # per objective: within 1% relative or strictly better
+    slack = 0.01 * np.maximum(1.0, np.abs(cold_nmll))
+    assert np.all(warm_nmll <= cold_nmll + slack), (warm_nmll, cold_nmll)
+
+
+def test_refit_ineligible_training_set_falls_back_to_warm():
+    """A training set that is NOT an append-only extension (rows
+    reordered/replaced) must not take the rank path."""
+    dim = 5
+    X, Y = _pool(120, dim=dim)
+    ctrl = SurrogateRefitController(
+        SurrogateRefitConfig("warm", rank_update_after=0, audit_every=99)
+    )
+    _train(ctrl, X[:80], Y[:80])
+    # different leading rows — prefix check must reject
+    _train(ctrl, X[20:110], Y[20:110])
+    assert ctrl.path_history == ["cold", "warm"]
+
+
+def test_unsupported_surrogate_falls_back_cold():
+    """MEGP (shared-kernel fit) is outside the warm family: the
+    controller steps aside and the plain constructor runs."""
+    dim = 3
+    X, Y = _pool(60, dim=dim)
+    ctrl = SurrogateRefitController(SurrogateRefitConfig("warm"))
+    sm = moasmo.train(
+        dim, 2, np.zeros(dim), np.ones(dim), X, Y, None,
+        surrogate_method_name="megp",
+        surrogate_method_kwargs={"n_starts": 2, "n_iter": 40, "seed": 0},
+        surrogate_refit=ctrl,
+    )
+    assert ctrl.path_history == []  # controller never engaged
+    assert sm.predict(X[:4])[0].shape == (4, 2)
+
+
+# -------------------------------------------------- cold-mode regression
+
+
+def test_cold_mode_is_bitwise_identical():
+    """`surrogate_refit="cold"` (and the default None) must reproduce
+    the pre-refit fit outputs exactly: same Cholesky, alpha, and
+    hyperparameters, bit for bit."""
+    dim = 5
+    X, Y = _pool(90, dim=dim)
+    base = _train(None, X, Y)
+    # mode="cold" resolves to no controller at the strategy layer; at
+    # the train() layer the equivalent is surrogate_refit=None — also
+    # pin the explicit constructor spelling
+    again = _train(None, X, Y)
+    direct = GPR_Matern(
+        X, Y, dim, 2, np.zeros(dim), np.ones(dim), **FAST
+    )
+    for a, b in ((base, again), (base, direct)):
+        for field in ("L", "alpha", "amp", "ls", "noise", "nmll"):
+            assert np.array_equal(
+                np.asarray(getattr(a.fit, field)),
+                np.asarray(getattr(b.fit, field)),
+            ), field
+
+
+def test_cold_mode_driver_trajectory_identical(tmp_path):
+    """End-to-end: a seeded driver run with surrogate_refit="cold" and
+    one with the default produce byte-identical archives."""
+    import dmosopt_tpu
+
+    def run(opt_id, **extra):
+        params = {
+            "opt_id": opt_id,
+            "obj_fun": _objective_flat,
+            "objective_names": ["f1", "f2"],
+            "space": {f"x{i}": [0.0, 1.0] for i in range(4)},
+            "problem_parameters": {},
+            "n_initial": 3,
+            "n_epochs": 3,
+            "population_size": 16,
+            "num_generations": 8,
+            "resample_fraction": 0.5,
+            "optimizer_name": "nsga2",
+            "surrogate_method_name": "gpr",
+            "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 30, "seed": 0},
+            "random_seed": 11,
+            "telemetry": False,
+            **extra,
+        }
+        dmosopt_tpu.run(params, verbose=False)
+        from dmosopt_tpu.driver import dopt_dict
+
+        strat = dopt_dict[opt_id].optimizer_dict[0]
+        return strat.x.copy(), strat.y.copy()
+
+    x_default, y_default = run("refit_traj_default")
+    x_cold, y_cold = run("refit_traj_cold", surrogate_refit="cold")
+    assert np.array_equal(x_default, x_cold)
+    assert np.array_equal(y_default, y_cold)
+
+
+def _objective_flat(pp):
+    x = np.array([pp[f"x{i}"] for i in range(4)])
+    return np.array([float(np.sum(x**2)), float(np.sum((x - 0.5) ** 2))])
+
+
+# ------------------------------------------------------- warm end-to-end
+
+
+def test_warm_driver_run_quality_and_state(tmp_path):
+    """A seeded warm-mode driver run engages the reuse paths, persists
+    its warm state with the checkpoint, and matches the cold run's
+    solution quality (non-dominated front within tolerance on ZDT1)."""
+    import dmosopt_tpu
+    from dmosopt_tpu.benchmarks.zdt import zdt1, zdt1_pareto, distance_to_front
+    from dmosopt_tpu.storage import load_refit_state_from_h5
+
+    def run(opt_id, refit, file_path=None):
+        params = {
+            "opt_id": opt_id,
+            "obj_fun": zdt1,
+            "jax_objective": True,
+            "objective_names": ["f1", "f2"],
+            "space": {f"x{i}": [0.0, 1.0] for i in range(6)},
+            "problem_parameters": {},
+            "n_initial": 6,
+            "n_epochs": 4,
+            "population_size": 32,
+            "num_generations": 20,
+            "resample_fraction": 0.5,
+            "optimizer_name": "nsga2",
+            "surrogate_method_name": "gpr",
+            "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 40, "seed": 0},
+            "surrogate_refit": refit,
+            "random_seed": 21,
+            "telemetry": False,
+        }
+        if file_path is not None:
+            params.update(file_path=file_path, save=True)
+        best = dmosopt_tpu.run(params, verbose=False)
+        _, lres = best
+        y = np.column_stack([v for _, v in lres])
+        from dmosopt_tpu.driver import dopt_dict
+
+        return y, dopt_dict[opt_id]
+
+    h5 = str(tmp_path / "warm.h5")
+    y_cold, _ = run("refit_e2e_cold", "cold")
+    y_warm, dopt = run(
+        "refit_e2e_warm",
+        {"mode": "warm", "rank_update_after": 1, "audit_every": 10},
+        file_path=h5,
+    )
+    ctrl = dopt.optimizer_dict[0].refit_controller
+    assert ctrl is not None
+    assert ctrl.path_history[0] == "cold"
+    assert any(p in ("warm", "rank", "rank_refactor")
+               for p in ctrl.path_history[1:])
+
+    front = zdt1_pareto(300)
+    d_cold = float(np.median(distance_to_front(y_cold, front)))
+    d_warm = float(np.median(distance_to_front(y_warm, front)))
+    # warm within tolerance of cold (generous: tiny budgets are noisy)
+    assert d_warm <= max(2.0 * d_cold, 0.25), (d_warm, d_cold)
+
+    # warm state landed in the checkpoint and seeds a resumed controller
+    state = load_refit_state_from_h5(h5, "refit_e2e_warm", 0)
+    assert state is not None and "amp" in state
+    seeded = SurrogateRefitController(
+        SurrogateRefitConfig("warm"), seed_state=state
+    )
+    assert seeded.has_state
+    np.testing.assert_allclose(
+        seeded._hyper["amp"], np.asarray(ctrl._hyper["amp"])
+    )
+
+
+def test_seeded_controller_first_fit_is_warm():
+    """A controller seeded from checkpoint state warm-starts its first
+    fit (no cached factor — never a rank update)."""
+    dim = 5
+    X, Y = _pool(80, dim=dim)
+    donor = SurrogateRefitController(SurrogateRefitConfig("warm"))
+    _train(donor, X[:70], Y[:70])
+    state = donor.export_state()
+    # even a "stable" seeded counter must not produce a rank update
+    state["stable"] = 5
+    seeded = SurrogateRefitController(
+        SurrogateRefitConfig("warm", rank_update_after=1),
+        seed_state=state,
+    )
+    sm = _train(seeded, X, Y)
+    assert seeded.path_history == ["warm"]
+    assert sm.predict(X[:3])[0].shape == (3, 2)
+
+
+def test_mismatched_warm_state_refits_cold():
+    """Warm state whose lengthscale shape no longer matches the fit
+    configuration (e.g. a resume after flipping `anisotropic`) falls
+    back to a cold fit instead of crashing."""
+    dim = 5
+    X, Y = _pool(90, dim=dim)
+    donor = SurrogateRefitController(SurrogateRefitConfig("warm"))
+    _train(donor, X[:70], Y[:70])  # isotropic: ls shape (2, 1)
+    seeded = SurrogateRefitController(
+        SurrogateRefitConfig("warm"), seed_state=donor.export_state()
+    )
+    sm = moasmo.train(
+        dim, 2, np.zeros(dim), np.ones(dim), X, Y, None,
+        surrogate_method_kwargs=dict(FAST, anisotropic=True),  # ls (2, 5)
+        surrogate_refit=seeded,
+    )
+    assert seeded.path_history == ["cold"]
+    assert sm.fit.ls.shape == (2, dim)
+
+
+def test_refit_config_validation():
+    with pytest.raises(ValueError):
+        SurrogateRefitConfig("lukewarm")
+    with pytest.raises(TypeError):
+        SurrogateRefitConfig.from_spec(3.14)
+    cfg = SurrogateRefitConfig.from_spec({"mode": "warm", "audit_every": 7})
+    assert cfg.audit_every == 7
+    assert SurrogateRefitConfig.from_spec(None).mode == "cold"
+    assert SurrogateRefitConfig.from_spec(cfg) is cfg
+    with pytest.raises(ValueError, match="mode"):
+        # a tuning dict without an explicit mode must not silently
+        # resolve to the cold default
+        SurrogateRefitConfig.from_spec({"hyper_tol": 0.2})
