@@ -32,6 +32,11 @@ bench-rank:
 bench-gp:
 	env DMOSOPT_BENCH_ONLY=gp_refit python bench.py
 
+# the surrogate-predict microbench alone (per-generation predict wall of
+# the solve/matmul/nystrom regimes vs archive N + compiled temp bytes)
+bench-predict:
+	env DMOSOPT_BENCH_ONLY=surrogate_predict python bench.py
+
 # Warm .jax_bench_cache with the EXACT programs the round-end bench
 # compiles: one full bench pass, JSON line discarded. Run AFTER the last
 # code commit — any change to optimizer state layouts or jitted program

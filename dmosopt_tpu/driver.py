@@ -46,6 +46,7 @@ from dmosopt_tpu.parallel.evaluator import (
     HostFunEvaluator,
     JaxBatchEvaluator,
 )
+from dmosopt_tpu.models.predictor import set_predictor_telemetry
 from dmosopt_tpu.ops.dominance import set_rank_telemetry
 from dmosopt_tpu.parallel.pipeline import BackgroundWriter, PipelineConfig
 from dmosopt_tpu.strategy import DistOptStrategy
@@ -579,12 +580,20 @@ class DistOptimizer:
 
     def get_stats(self):
         """Merged per-problem stats; paired `<phase>_start`/`<phase>_end`
-        timestamps collapse into a single `<phase>` duration."""
+        timestamps collapse into a single `<phase>` duration.
+
+        A single-problem run (id 0) keeps the historical unprefixed
+        keys. A multi-problem run prefixes EVERY problem's keys with its
+        id — problem 0 included: unprefixed, its keys collide with both
+        the driver's own entries (e.g. `init_sampling_*`) and the merged
+        phase names of the other problems, silently overwriting one with
+        the other."""
+        multi = len(self.problem_ids) > 1
         for pid in self.problem_ids:
             strategy = self.optimizer_dict.get(pid)
             if strategy is None:
                 continue
-            prefix = f"{pid}_" if pid > 0 else ""
+            prefix = f"{pid}_" if (multi or pid > 0) else ""
             self.stats.update(
                 (prefix + k, v) for k, v in strategy.stats.items()
             )
@@ -1444,6 +1453,9 @@ def run(
     # detached in the finally below so a finished or aborted run can
     # never leak its registry into later eager ranking calls
     set_rank_telemetry(dopt.telemetry)
+    # same span/teardown contract for the surrogate predictor layer's
+    # build/predict metrics (models/predictor.py)
+    set_predictor_telemetry(dopt.telemetry)
     dopt.logger.info(f"Optimizing for {dopt.n_epochs} epochs...")
     body_ok = False
     try:
@@ -1492,9 +1504,11 @@ def run(
                 raise
             dopt.logger.exception("background writer close failed")
         finally:
-            # detach the rank-path hook so a later non-telemetry caller
-            # in this process can't record into a closed run's registry
+            # detach the rank-path and predictor hooks so a later
+            # non-telemetry caller in this process can't record into a
+            # closed run's registry
             set_rank_telemetry(None)
+            set_predictor_telemetry(None)
             # only close a Telemetry this run created: a pass-through
             # user-supplied instance may be shared across runs (one JSONL
             # sink for a sweep) and closing it would silently drop the

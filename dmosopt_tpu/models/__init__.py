@@ -42,3 +42,7 @@ from dmosopt_tpu.models.gp import (  # noqa: E402,F401
     EGP_Matern,
     MEGP_Matern,
 )
+from dmosopt_tpu.models.predictor import (  # noqa: E402,F401
+    GPPredictor,
+    PREDICTOR_MODES,
+)

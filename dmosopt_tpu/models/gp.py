@@ -727,7 +727,10 @@ def clone_with_fit(prev, fit: GPFit, fit_info: dict):
     """New surrogate of `prev`'s class sharing its normalization state
     but carrying an updated posterior — the result object of a rank-k
     append (or bucket-crossing refactorization), built without running
-    the constructor's hyperparameter fit."""
+    the constructor's hyperparameter fit. The predictor cache is NOT
+    carried over (it belongs to the previous posterior — serving it
+    would be the stale-predictor hazard); callers that can extend it
+    incrementally set `_predictor_obj` themselves afterwards."""
     new = object.__new__(type(prev))
     for attr in (
         "nInput", "nOutput", "xlb", "xub", "xrg",
@@ -735,6 +738,9 @@ def clone_with_fit(prev, fit: GPFit, fit_info: dict):
     ):
         setattr(new, attr, getattr(prev, attr))
     new._rel_jitter = getattr(prev, "_rel_jitter", None)
+    new._predictor_spec = dict(getattr(prev, "_predictor_spec", None) or {})
+    new._mesh = getattr(prev, "_mesh", None)
+    new._predictor_obj = None
     new.fit = fit
     new.fit_info = fit_info
     return new
@@ -834,6 +840,27 @@ def _resolve_dtype(dtype):
     return dt
 
 
+def _resolve_predictor_spec(
+    predictor, nystrom_points, nystrom_probe_points, nystrom_mean_tol,
+    nystrom_var_ratio_tol,
+):
+    """Validate and pack the exact-GP family's predictor options (the
+    `GPPredictor` constructor kwargs minus fit/kernel/mesh)."""
+    from dmosopt_tpu.models.predictor import PREDICTOR_MODES
+
+    if predictor not in PREDICTOR_MODES:
+        raise ValueError(
+            f"predictor {predictor!r} not in {PREDICTOR_MODES}"
+        )
+    return dict(
+        mode=predictor,
+        nystrom_points=int(nystrom_points),
+        nystrom_probe_points=int(nystrom_probe_points),
+        nystrom_mean_tol=float(nystrom_mean_tol),
+        nystrom_var_ratio_tol=float(nystrom_var_ratio_tol),
+    )
+
+
 class SurrogateMixin:
     """Shared surrogate wrapper surface: unit-box x normalization and the
     reference's ``predict``/``evaluate`` contract on top of a jax-traceable
@@ -903,6 +930,11 @@ class GPR_Matern(SurrogateMixin):
         convergence_tol="auto",
         convergence_check_every: Optional[int] = None,
         warm_start=None,
+        predictor: str = "solve",
+        nystrom_points: int = 512,
+        nystrom_probe_points: int = 256,
+        nystrom_mean_tol: float = 0.1,
+        nystrom_var_ratio_tol: float = 3.0,
         mesh=None,
         logger=None,
         **kwargs,
@@ -910,6 +942,12 @@ class GPR_Matern(SurrogateMixin):
         self.return_mean_variance = return_mean_variance
         self.logger = logger
         self._dtype = dt = _resolve_dtype(dtype)
+        self._predictor_spec = _resolve_predictor_spec(
+            predictor, nystrom_points, nystrom_probe_points,
+            nystrom_mean_tol, nystrom_var_ratio_tol,
+        )
+        self._mesh = mesh
+        self._predictor_obj = None
         X, Yn, y_mean, y_std = _prepare_training_data(
             self, xin, yin, nInput, nOutput, xlb, xub, nan, top_k
         )
@@ -964,9 +1002,37 @@ class GPR_Matern(SurrogateMixin):
         )
         self.fit_info = _gp_fit_info(fit, n_iter)
 
-    # jax-traceable prediction on unit-box-normalized input
+    # jax-traceable prediction on unit-box-normalized input, routed
+    # through the per-fit predictor (predictor="solve" — the default —
+    # IS the verbatim `gp_predict` program; see models/predictor.py)
     def predict_normalized(self, Xq: jax.Array):
-        return gp_predict(self.fit, Xq, kernel=self.kernel)
+        return self._predictor().predict_normalized(Xq)
+
+    def _predictor(self):
+        if self._predictor_obj is None:
+            from dmosopt_tpu.models.predictor import GPPredictor
+
+            self._predictor_obj = GPPredictor(
+                self.fit, self.kernel, mesh=self._mesh,
+                rel_jitter=getattr(self, "_rel_jitter", None),
+                **self._predictor_spec,
+            )
+        return self._predictor_obj
+
+    def build_predictor(self):
+        """Build (or return) the per-fit predictive cache eagerly — the
+        per-epoch build `moasmo.train` triggers so the O(N³) cache
+        preparation lands inside the timed `train` phase instead of the
+        first EA generation."""
+        return self._predictor()
+
+    @property
+    def predictor_regime(self) -> str:
+        """Regime actually serving predictions (the requested mode, or
+        `matmul` after a nystrom distillation-probe fallback)."""
+        if self._predictor_obj is not None:
+            return self._predictor_obj.regime
+        return self._predictor_spec["mode"]
 
 
 class GPR_RBF(GPR_Matern):
@@ -1023,11 +1089,22 @@ class MEGP_Matern(SurrogateMixin):
         learning_rate: float = 0.1,
         convergence_tol="auto",
         convergence_check_every: Optional[int] = None,
+        predictor: str = "solve",
+        nystrom_points: int = 512,
+        nystrom_probe_points: int = 256,
+        nystrom_mean_tol: float = 0.1,
+        nystrom_var_ratio_tol: float = 3.0,
         logger=None,
         **kwargs,
     ):
         self.return_mean_variance = return_mean_variance
         self.logger = logger
+        self._predictor_spec = _resolve_predictor_spec(
+            predictor, nystrom_points, nystrom_probe_points,
+            nystrom_mean_tol, nystrom_var_ratio_tol,
+        )
+        self._mesh = None
+        self._predictor_obj = None
         X, Yn, y_mean, y_std = _prepare_training_data(
             self, xin, yin, nInput, nOutput, xlb, xub, nan, top_k
         )
@@ -1055,3 +1132,6 @@ class MEGP_Matern(SurrogateMixin):
         self.fit_info = _gp_fit_info(fit, n_iter)
 
     predict_normalized = GPR_Matern.predict_normalized
+    _predictor = GPR_Matern._predictor
+    build_predictor = GPR_Matern.build_predictor
+    predictor_regime = GPR_Matern.predictor_regime

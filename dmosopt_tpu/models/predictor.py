@@ -1,0 +1,550 @@
+"""MXU-friendly surrogate inference: per-epoch predictive caches for the
+exact-GP family.
+
+The inner EA is the hot path: hundreds of generations per epoch run
+against the surrogate (SURVEY §2), and after the fit-side reuse work the
+dominant per-generation cost is `gp_predict`'s triangular solve —
+`solve_triangular(L, Ks)` is O(N²·M) per objective per generation,
+inherently sequential (a back-substitution recurrence), and a poor fit
+for the TPU MXU, with N (the archive) growing every epoch. The
+tensorized-EMO line (arXiv:2503.20286) and GPU-resident GPR servers with
+precomputed device-side factors (PAPERS.md) both get their win the same
+way: turn per-query solves into batched matmuls against factors prepared
+once.
+
+`GPPredictor` is that layer here: built once per fit/refit (the `models`
+layer), consumed by every generation of the epoch's inner EA loop
+(moasmo → strategy → driver). Three regimes, routed per PR 3's
+regime-split discipline — the default path is kept VERBATIM because the
+solve→matmul rewrite changes ulps, and ulp drift was previously bisected
+as a silent trajectory breaker (see `ops/distances.py`):
+
+- ``solve`` (default) — today's `gp_predict`, bitwise-frozen; the test
+  oracle for the other two regimes.
+- ``matmul`` — materialize the whitening factor ``W = L⁻¹`` once per
+  epoch at O(N³) amortized over all generations; per-generation variance
+  becomes pure batched matmul (``var = amp + noise − Σ (W Ks)²``), MXU
+  work with no sequential solves. The (d, P, P) cache is a device-
+  resident jax array for the whole epoch, and a rank-k append extends
+  it by the block triangular-inverse identity (`extend_whitened_rank_k`)
+  instead of refactorizing.
+- ``nystrom`` — opt-in low-rank distillation onto m inducing columns
+  (a deterministic stride subsample of the training rows): in the
+  whitened inducing basis ``φ(x) = Lzz⁻¹k(Z, x)`` the posterior is
+  projected to ``mean ≈ φᵀw``, ``var ≈ amp + noise − φᵀBφ`` with ``w``
+  (m,) and ``B`` (m, m) prepared once, so per-generation cost is
+  O(m²·M) — *flat in archive size*. A distillation-error probe on a
+  held-out slab of training rows gates the regime: if the standardized
+  mean error or the variance ratio exceeds tolerance, the predictor
+  silently falls back to ``matmul`` (never to a worse answer).
+
+Telemetry rides the same process-level hook pattern as the rank kernels
+(`ops/dominance.set_rank_telemetry`): the driver attaches its Telemetry
+for the span of a run, and the predictor records builds, cache bytes,
+distillation error, and eager predict latency. Traced (in-graph) predict
+calls record nothing — one symbolic call per compilation.
+
+Caches are derived state: nothing here is persisted; a resumed run
+rebuilds its predictor from the first refit.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu.models.gp import (
+    _JITTER,
+    _KERNELS,
+    _default_rel_jitter,
+    GPFit,
+    gp_predict,
+)
+
+#: predictor regimes accepted by the exact-GP family's ``predictor`` knob
+PREDICTOR_MODES = ("solve", "matmul", "nystrom")
+
+# Optional process-level telemetry hook (set by the driver): predictor
+# builds and *eager* predict calls record metrics; inside a jit trace
+# there is one symbolic call per compilation, so counting there would be
+# meaningless. See `set_predictor_telemetry`.
+_TELEMETRY = None
+
+
+def set_predictor_telemetry(tel) -> None:
+    """Attach a `dmosopt_tpu.telemetry.Telemetry` (or None) to the
+    predictor layer. Builds then record `gp_predictor_builds_total`,
+    the `gp_predictor_cache_bytes` gauge, `gp_distill_error` (nystrom),
+    and eager predict calls observe `gp_predict_seconds`. Process-global;
+    the driver sets it for the span of a run and clears it on teardown."""
+    global _TELEMETRY
+    _TELEMETRY = tel
+
+
+# ------------------------------------------------------------ matmul regime
+#
+# The cache is W = L⁻¹ (the whitening factor), not the explicit kernel
+# inverse: var = amp + noise − ‖W Ks‖² is a sum of squares whose f32
+# error scales with cond(L) = √cond(K) — the explicit-inverse quadratic
+# form Ksᵀ(K⁻¹)Ks loses cond(K)·eps, which at the f32 jitter floor is
+# larger than the posterior variance itself near training points
+# (measured: 6× the variance scale at N=90). Same per-generation cost:
+# one (P, P)·(P, M) matmul per objective, zero triangular solves.
+
+
+@jax.jit
+def build_whitened_cache(fit: GPFit) -> jax.Array:
+    """(d, P, P) inverse Cholesky factor ``W = L⁻¹`` of the masked,
+    regularized training kernel. O(N³) once per fit, amortized over
+    every generation of the epoch. Padded rows are decoupled (identity
+    blocks in both L and W), so the cache composes with
+    `_pad_to_bucket` static shapes unchanged."""
+    P = fit.L.shape[-1]
+    eye = jnp.eye(P, dtype=fit.L.dtype)
+
+    def one(L):
+        return jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+
+    return jax.vmap(one)(fit.L)
+
+
+@partial(jax.jit, static_argnames=("kernel", "query_sharding"))
+def gp_predict_matmul(
+    fit: GPFit,
+    W: jax.Array,  # (d, P, P) from `build_whitened_cache`
+    Xq: jax.Array,  # (M, n)
+    kernel: str = "matern52",
+    query_sharding=None,
+):
+    """Posterior mean/variance with the variance as pure batched matmul:
+    ``var = amp + noise − Σₙ (W Ks)²`` — no triangular solve in the
+    per-generation program (``W Ks`` equals ``L⁻¹ Ks``, the quantity the
+    solve path back-substitutes for). Mean is the identical ``Ksᵀα``
+    product the solve path computes. Returns ((M, d), (M, d)) like
+    `gp_predict`.
+
+    `query_sharding` (a hashable `NamedSharding`, static) constrains the
+    query axis so the predict inside a mesh-sharded inner EA scan runs
+    SPMD over the population axis with the (d, P, P) cache replicated.
+    """
+    kernel_fn = _KERNELS[kernel]
+    if query_sharding is not None:
+        Xq = jax.lax.with_sharding_constraint(Xq, query_sharding)
+
+    def one(W_i, alpha, amp, ls, noise, ym, ys):
+        Ks = kernel_fn(fit.X, Xq, ls, amp)  # (P, M)
+        Ks = Ks * fit.train_mask[:, None].astype(Ks.dtype)
+        mean = Ks.T @ alpha
+        v = jnp.matmul(W_i, Ks, precision="highest")  # (P, M) = L⁻¹Ks
+        var = amp + noise - jnp.sum(v * v, axis=0)
+        var = jnp.maximum(var, 1e-12)
+        return ym + ys * mean, ys * ys * var
+
+    mean, var = jax.vmap(one)(
+        W, fit.alpha, fit.amp, fit.ls, fit.noise, fit.y_mean, fit.y_std
+    )
+    return mean.T, var.T
+
+
+@partial(jax.jit, static_argnames=("n_old", "n_new"))
+def extend_whitened_rank_k(
+    W_old: jax.Array,  # (d, P, P) cache for the previous training set
+    L_new: jax.Array,  # (d, P, P) factor AFTER `extend_cholesky_rank_k`
+    n_old: int,
+    n_new: int,
+) -> jax.Array:
+    """Rank-k update of the whitening cache for rows appended inside the
+    padding bucket — the block triangular-inverse identity:
+
+        [L11  0 ]⁻¹ = [W11                 0    ]      W11 = L11⁻¹
+        [L21  L22]    [−L22⁻¹ L21 W11   L22⁻¹]
+
+    with ``L21``/``L22`` read off the already-updated factor from
+    `extend_cholesky_rank_k`. O(N²k) per objective instead of the O(N³)
+    rebuild, so speculative-pipeline stragglers that ride the rank-k
+    refit path extend the predictor cache too instead of silently
+    serving a stale one. Rows ≥ n_new keep their decoupled identity
+    block."""
+    k = n_new - n_old
+
+    def one(W_prev, L_i):
+        W11 = W_prev[:n_old, :n_old]
+        L21 = L_i[n_old:n_new, :n_old]
+        L22 = L_i[n_old:n_new, n_old:n_new]
+        W22 = jax.scipy.linalg.solve_triangular(
+            L22, jnp.eye(k, dtype=L_i.dtype), lower=True
+        )
+        W21 = -jnp.matmul(
+            W22, jnp.matmul(L21, W11, precision="highest"),
+            precision="highest",
+        )
+        W = W_prev.at[n_old:n_new, :n_old].set(W21)
+        W = W.at[n_old:n_new, n_old:n_new].set(W22)
+        return W
+
+    return jax.vmap(one)(W_old, L_new)
+
+
+# ----------------------------------------------------------- nystrom regime
+
+
+class NystromCache(NamedTuple):
+    """Distilled posterior: everything per-generation predict needs, with
+    no array whose size depends on the archive length N. All quantities
+    live in the whitened inducing basis ``φ(x) = Lzz⁻¹ k(Z, x)`` — one
+    application of Kzz's conditioning per side instead of the explicit
+    ``Kzz⁻¹ · Kzz⁻¹`` sandwich, which in f32 destroys the distillation
+    whenever the inducing kernel is smooth (large lengthscales)."""
+
+    Z: jax.Array  # (m, n) inducing inputs (subset of training rows)
+    Wzz: jax.Array  # (d, m, m) whitening factor Lzz⁻¹ of the inducing kernel
+    w: jax.Array  # (d, m) distilled mean weights in the whitened basis
+    B: jax.Array  # (d, m, m) distilled variance form φᵀBφ (PSD)
+    amp: jax.Array  # (d,)
+    ls: jax.Array  # (d, L)
+    noise: jax.Array  # (d,)
+    y_mean: jax.Array  # (d,)
+    y_std: jax.Array  # (d,)
+
+
+@partial(jax.jit, static_argnames=("kernel", "rel_jitter"))
+def build_nystrom_cache(
+    fit: GPFit,
+    z_idx: jax.Array,  # (m,) int32 indices into fit.X (real rows only)
+    kernel: str,
+    rel_jitter: float,
+) -> NystromCache:
+    """Distill the exact posterior onto the m inducing columns
+    ``Z = X[z_idx]`` (Nyström/DTC projection of the cross-covariance:
+    ``k(x, X) ≈ k(x, Z) Kzz⁻¹ k(Z, X)``). In the whitened basis
+    ``φ(x) = Lzz⁻¹ k(Z, x)``:
+
+        mean ≈ φ(x)ᵀ w,      w = Lzz⁻¹ K_zX α
+        var  ≈ amp + noise − φ(x)ᵀ B φ(x),
+               B = (L⁻¹ K_Xz Lzz⁻ᵀ)ᵀ (L⁻¹ K_Xz Lzz⁻ᵀ)   (PSD by construction)
+
+    Build cost is O(N²m) per objective (one triangular solve against the
+    cached factor with m right-hand sides); per-generation predict is
+    O(m²·M) — independent of N."""
+    kernel_fn = _KERNELS[kernel]
+    if rel_jitter is None:
+        rel_jitter = _default_rel_jitter(fit.X.dtype)
+    Z = fit.X[z_idx]
+    m = Z.shape[0]
+
+    def one(L, alpha, amp_i, ls_i, noise_i):
+        jitter = _JITTER + rel_jitter * amp_i
+        Kzz = kernel_fn(Z, Z, ls_i, amp_i)
+        Kzz = 0.5 * (Kzz + Kzz.T) + jitter * jnp.eye(m, dtype=Z.dtype)
+        Lzz = jnp.linalg.cholesky(Kzz)
+        Wzz = jax.scipy.linalg.solve_triangular(
+            Lzz, jnp.eye(m, dtype=Z.dtype), lower=True
+        )
+        C = kernel_fn(Z, fit.X, ls_i, amp_i)  # (m, P)
+        C = C * fit.train_mask[None, :].astype(C.dtype)
+        T = jnp.matmul(Wzz, C, precision="highest")  # (m, P) = Lzz⁻¹C
+        w = T @ alpha
+        A1 = jax.scipy.linalg.solve_triangular(L, T.T, lower=True)  # (P, m)
+        B = jnp.matmul(A1.T, A1, precision="highest")
+        return Wzz, w, 0.5 * (B + B.T)
+
+    Wzz, w, B = jax.vmap(one)(fit.L, fit.alpha, fit.amp, fit.ls, fit.noise)
+    return NystromCache(
+        Z=Z, Wzz=Wzz, w=w, B=B, amp=fit.amp, ls=fit.ls, noise=fit.noise,
+        y_mean=fit.y_mean, y_std=fit.y_std,
+    )
+
+
+@partial(jax.jit, static_argnames=("kernel", "query_sharding"))
+def gp_predict_nystrom(
+    cache: NystromCache,
+    Xq: jax.Array,  # (M, n)
+    kernel: str = "matern52",
+    query_sharding=None,
+):
+    """Posterior mean/variance from the distilled cache — all batched
+    matmul against (m, m) factors; cost has no N term."""
+    kernel_fn = _KERNELS[kernel]
+    if query_sharding is not None:
+        Xq = jax.lax.with_sharding_constraint(Xq, query_sharding)
+
+    def one(Wzz, w, B, amp, ls, noise, ym, ys):
+        Kq = kernel_fn(cache.Z, Xq, ls, amp)  # (m, M)
+        phi = jnp.matmul(Wzz, Kq, precision="highest")  # (m, M)
+        mean = phi.T @ w
+        var = amp + noise - jnp.sum(
+            phi * jnp.matmul(B, phi, precision="highest"), axis=0
+        )
+        var = jnp.maximum(var, 1e-12)
+        return ym + ys * mean, ys * ys * var
+
+    mean, var = jax.vmap(one)(
+        cache.Wzz, cache.w, cache.B, cache.amp, cache.ls, cache.noise,
+        cache.y_mean, cache.y_std,
+    )
+    return mean.T, var.T
+
+
+# --------------------------------------------------------------- the layer
+
+
+def _pytree_bytes(tree) -> int:
+    return int(
+        sum(
+            leaf.nbytes
+            for leaf in jax.tree_util.tree_leaves(tree)
+            if hasattr(leaf, "nbytes")
+        )
+    )
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class GPPredictor:
+    """Per-fit predictive cache for one `GPFit`, consumed by the inner
+    EA loop for every generation of the epoch.
+
+    ``mode`` requests a regime; ``regime`` is what actually serves
+    (nystrom falls back to matmul when its distillation probe fails).
+    The build runs eagerly in the constructor — fit arrays are always
+    concrete — so no cache construction is ever baked into the scanned
+    generation program."""
+
+    def __init__(
+        self,
+        fit: GPFit,
+        kernel: str,
+        mode: str = "solve",
+        *,
+        rel_jitter: Optional[float] = None,
+        mesh=None,
+        nystrom_points: int = 512,
+        nystrom_probe_points: int = 256,
+        nystrom_mean_tol: float = 0.1,
+        nystrom_var_ratio_tol: float = 3.0,
+    ):
+        if mode not in PREDICTOR_MODES:
+            raise ValueError(
+                f"predictor mode {mode!r} not in {PREDICTOR_MODES}"
+            )
+        self.fit = fit
+        self.kernel = kernel
+        self.mode = mode
+        self.regime = mode
+        self._rel_jitter = (
+            rel_jitter
+            if rel_jitter is not None
+            else _default_rel_jitter(fit.X.dtype)
+        )
+        self._opts = dict(
+            nystrom_points=int(nystrom_points),
+            nystrom_probe_points=int(nystrom_probe_points),
+            nystrom_mean_tol=float(nystrom_mean_tol),
+            nystrom_var_ratio_tol=float(nystrom_var_ratio_tol),
+        )
+        self.whitened = None  # (d, P, P) W = L⁻¹ (matmul regime)
+        self.nystrom = None  # NystromCache (nystrom regime)
+        self.distill_error: Optional[dict] = None
+        # the solve regime stays VERBATIM `gp_predict` — no sharding
+        # constraint is ever added to it (the frozen program is the
+        # bitwise oracle); matmul/nystrom constrain the query axis so
+        # the sharded inner loop keeps predict SPMD over the population
+        self._query_sharding = None
+        if mesh is not None and mode != "solve":
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._query_sharding = NamedSharding(
+                mesh, PartitionSpec(mesh.axis_names[0])
+            )
+        t0 = time.perf_counter()
+        self._build()
+        self._record_build(time.perf_counter() - t0)
+
+    # ------------------------------------------------------------- build
+
+    def _build(self):
+        if self.mode == "solve":
+            return
+        if self.mode == "nystrom":
+            if self._build_nystrom():
+                return
+            self.regime = "matmul"  # probe-gated fallback
+        # sync before the build timer stops: without it an async backend
+        # returns a dispatched-but-unfinished cache — build_s would read
+        # ~0 and the O(N³) compute would land in the first EA generation,
+        # the exact cost the eager train-phase build exists to absorb
+        self.whitened = jax.block_until_ready(
+            build_whitened_cache(self.fit)
+        )
+
+    def _real_rows(self) -> np.ndarray:
+        return np.flatnonzero(np.asarray(self.fit.train_mask) > 0.0)
+
+    def _build_nystrom(self) -> bool:
+        """Distill and probe; True when the distilled cache is within
+        tolerance (the probe compares against the exact solve oracle on
+        a held-out slab of training rows)."""
+        real = self._real_rows()
+        m = min(self._opts["nystrom_points"], len(real))
+        # deterministic stride subsample: even coverage of the archive
+        # in insertion order, no RNG (predictor builds must not perturb
+        # any seeded trajectory)
+        z_pos = np.unique(
+            np.round(np.linspace(0, len(real) - 1, m)).astype(np.int64)
+        )
+        z_idx = real[z_pos]
+        self.nystrom = build_nystrom_cache(
+            self.fit, jnp.asarray(z_idx, jnp.int32), kernel=self.kernel,
+            rel_jitter=self._rel_jitter,
+        )
+
+        held_out = np.setdiff1d(real, z_idx)
+        probe = held_out if len(held_out) else z_idx
+        n_probe = min(self._opts["nystrom_probe_points"], len(probe))
+        # stride over the whole held-out set, not its prefix: archives
+        # grow at the tail (resample batches concentrate near the
+        # front), so a prefix slab would certify the distillation on the
+        # oldest rows only and miss out-of-tolerance error exactly where
+        # the EA queries next
+        probe = probe[
+            np.unique(
+                np.round(np.linspace(0, len(probe) - 1, n_probe)).astype(
+                    np.int64
+                )
+            )
+        ]
+        Xp = self.fit.X[jnp.asarray(probe, jnp.int32)]
+        mean_e, var_e = gp_predict(self.fit, Xp, kernel=self.kernel)
+        mean_n, var_n = gp_predict_nystrom(
+            self.nystrom, Xp, kernel=self.kernel
+        )
+        y_std = np.maximum(np.asarray(self.fit.y_std, np.float64), 1e-12)
+        d_mean = np.abs(np.asarray(mean_n) - np.asarray(mean_e))
+        mean_err = float(np.max(d_mean / y_std[None, :]))
+        # var ratio floored at 0.1% of the (output-units) amplitude:
+        # exact variance at held-out TRAINING rows sits near the noise
+        # floor, where a ratio would amplify sub-noise disagreement the
+        # EA's exploration never sees; disagreement above the floor is
+        # what the gate is for
+        amp = np.asarray(self.fit.amp, np.float64)
+        noise = np.asarray(self.fit.noise, np.float64)
+        floor = 1e-3 * (amp + noise) * y_std**2  # (d,)
+        ve = np.maximum(np.asarray(var_e, np.float64), floor[None, :])
+        vn = np.maximum(np.asarray(var_n, np.float64), floor[None, :])
+        var_ratio = float(np.max(np.maximum(vn / ve, ve / vn)))
+        ok = (
+            mean_err <= self._opts["nystrom_mean_tol"]
+            and var_ratio <= self._opts["nystrom_var_ratio_tol"]
+        )
+        self.distill_error = {
+            "mean_err": mean_err,
+            "var_ratio": var_ratio,
+            "m": int(len(z_idx)),
+            "probe_points": int(len(probe)),
+            "ok": ok,
+        }
+        if not ok:
+            self.nystrom = None
+        return ok
+
+    def _record_build(self, build_s: float):
+        tel = _TELEMETRY
+        if not tel:
+            return
+        tel.inc("gp_predictor_builds_total", regime=self.regime)
+        tel.gauge("gp_predictor_cache_bytes", float(self.cache_bytes()))
+        fields = dict(
+            regime=self.regime, mode=self.mode,
+            n_train=int(np.sum(np.asarray(self.fit.train_mask) > 0.0)),
+            bucket=int(self.fit.X.shape[0]),
+            build_s=round(build_s, 6),
+            cache_bytes=int(self.cache_bytes()),
+        )
+        if self.distill_error is not None:
+            tel.gauge("gp_distill_error", self.distill_error["mean_err"])
+            fields.update(
+                distill_mean_err=round(self.distill_error["mean_err"], 6),
+                distill_var_ratio=round(self.distill_error["var_ratio"], 6),
+                distill_m=self.distill_error["m"],
+                fallback=not self.distill_error["ok"],
+            )
+        tel.event("gp_predictor", **fields)
+
+    def cache_bytes(self) -> int:
+        """Bytes held by the per-epoch cache beyond the fit itself."""
+        if self.regime == "matmul":
+            return _pytree_bytes(self.whitened)
+        if self.regime == "nystrom":
+            return _pytree_bytes(self.nystrom)
+        return 0
+
+    # ----------------------------------------------------------- predict
+
+    def predict_normalized(self, Xq):
+        """Mean/variance at unit-box queries, routed by regime. Eager
+        calls (concrete Xq, telemetry attached) time themselves into
+        `gp_predict_seconds`; traced calls add nothing to the program."""
+        tel = None if _is_tracer(Xq) else _TELEMETRY
+        t0 = time.perf_counter() if tel else None
+        if self.regime == "matmul":
+            out = gp_predict_matmul(
+                self.fit, self.whitened, Xq, kernel=self.kernel,
+                query_sharding=self._query_sharding,
+            )
+        elif self.regime == "nystrom":
+            out = gp_predict_nystrom(
+                self.nystrom, Xq, kernel=self.kernel,
+                query_sharding=self._query_sharding,
+            )
+        else:
+            out = gp_predict(self.fit, Xq, kernel=self.kernel)
+        if tel:
+            jax.block_until_ready(out)
+            tel.observe("gp_predict_seconds", time.perf_counter() - t0)
+        return out
+
+    # ----------------------------------------------- cross-epoch updates
+
+    def after_rank_update(
+        self, fit: GPFit, n_old: int, n_new: int
+    ) -> Optional["GPPredictor"]:
+        """Predictor for a posterior extended in place by
+        `extend_cholesky_rank_k` (same padding bucket). The matmul cache
+        is extended by the block-inversion identity at O(N²k); solve
+        carries no cache; nystrom returns None — its inducing set and
+        probe depend on the training rows, so the caller rebuilds (and
+        re-probes) lazily. Returning None always means "rebuild from
+        scratch on next use", never "serve the stale cache"."""
+        if self.regime == "solve":
+            return self._clone_for(fit)
+        if self.regime == "matmul" and self.whitened is not None and (
+            fit.L.shape == self.fit.L.shape
+        ):
+            t0 = time.perf_counter()
+            W = jax.block_until_ready(
+                extend_whitened_rank_k(
+                    self.whitened, fit.L, n_old=n_old, n_new=n_new
+                )
+            )
+            new = self._clone_for(fit)
+            new.whitened = W
+            new._record_build(time.perf_counter() - t0)
+            return new
+        return None
+
+    def _clone_for(self, fit: GPFit) -> "GPPredictor":
+        new = object.__new__(GPPredictor)
+        new.__dict__.update(self.__dict__)
+        new.fit = fit
+        new.whitened = None
+        new.nystrom = None
+        new.distill_error = None
+        new.regime = "solve" if self.mode == "solve" else "matmul"
+        return new

@@ -552,6 +552,19 @@ class SurrogateRefitController:
             "rank_rows": int(k),
         }
         sm = gp.clone_with_fit(prev, fit, fit_info)
+        # predictor-cache composition: `clone_with_fit` deliberately
+        # drops the previous predictor (serving it would be stale); an
+        # in-bucket append extends a built matmul cache by the block
+        # triangular-inverse identity at O(N²k)
+        # (`predictor.extend_whitened_rank_k`); anything else (nystrom,
+        # bucket crossing, never built) leaves the clone cache-less and
+        # `moasmo.train`'s eager build_predictor() rebuilds it inside
+        # the timed train phase
+        prev_pred = getattr(prev, "_predictor_obj", None)
+        if prev_pred is not None and path == "rank":
+            sm._predictor_obj = prev_pred.after_rank_update(
+                fit, n_old=n_old, n_new=n_new
+            )
         self._model = sm
         self._n_train = n_new
         self._fits_since_audit += 1

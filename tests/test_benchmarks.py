@@ -142,3 +142,28 @@ def test_rank_throughput_microbench_memory_bound():
     assert r16k["points_per_sec"] > 0  # tiled path actually ran at 16k
     assert r16k["peel_peak_temp_bytes"] > 1e9  # the blowup being removed
     assert r16k["tiled_peak_temp_bytes"] * 5 < r16k["peel_peak_temp_bytes"]
+
+
+def test_surrogate_predict_microbench_smoke():
+    """Fast-suite smoke of the `surrogate_predict` microbench harness at
+    tiny N: every regime row materializes with positive walls, real
+    cache/temp accounting, and the cross-N nystrom flatness ratio — so
+    the bench config (`make bench-predict`) can't silently rot."""
+    import bench
+
+    out = bench.bench_surrogate_predict(
+        archive_sizes=(64, 96), n_queries=16, nystrom_m=32, e2e=False
+    )
+    rows = out["surrogate_predict"]
+    for n in (64, 96):
+        row = rows[f"predict_n{n}"]
+        for key in ("solve_ms", "matmul_ms", "nystrom_ms"):
+            assert row[key] > 0, (n, key, row)
+        assert row["matmul_cache_bytes"] == 2 * n * n * 4
+        assert row["nystrom_m"] == 32
+        assert row["nystrom_cache_bytes"] > 0
+        for key in (
+            "solve_temp_bytes", "matmul_temp_bytes", "nystrom_temp_bytes",
+        ):
+            assert row[key] >= 0, (n, key, row)
+    assert rows["nystrom_flatness"] > 0

@@ -146,6 +146,55 @@ def test_unequal_multiproblem_queues_do_not_deadlock():
         assert dopt.optimizer_dict[pid].x is not None
 
 
+def test_multiproblem_stats_keys_are_disjoint():
+    """get_stats must prefix EVERY problem's keys in a multi-problem run
+    — problem 0 included. Unprefixed, problem 0's phase names collided
+    with the merged stats dict and silently overwrote each other."""
+
+    def mp_obj(mpp):
+        out = {}
+        for pid, pp in mpp.items():
+            x = np.array([pp[f"x{i}"] for i in range(N_DIM)])
+            out[pid] = np.array([x[0] + 0.01 * pid, 1.0 - x[0]])
+        return out
+
+    params = _base_params(
+        obj_fun=mp_obj,
+        problem_ids=set([0, 1]),
+        n_epochs=1,
+        num_generations=6,
+        population_size=16,
+        n_initial=3,
+    )
+    import dmosopt_tpu.driver as driver
+
+    dopt = driver.dopt_init(params, verbose=False, initialize_strategy=True)
+    while dopt.epoch_count < dopt.n_epochs:
+        dopt.run_epoch()
+    stats = dopt.get_stats()
+    # both problems' strategies produced the same per-epoch stat names;
+    # with deterministic prefixes both survive the merge
+    for pid in (0, 1):
+        pid_keys = [k for k in stats if k.startswith(f"{pid}_")]
+        assert any(k == f"{pid}_model_init" for k in pid_keys), stats.keys()
+        assert f"{pid}_eval_sum" in stats, stats.keys()
+    # problem stats never land unprefixed in a multi-problem run, so
+    # they cannot shadow (or be shadowed by) the driver's own entries
+    assert "model_init" not in stats
+    assert "eval_sum" not in stats
+
+    # single-problem runs keep the historical unprefixed keys
+    single = _base_params(
+        n_epochs=1, num_generations=6, population_size=16, n_initial=3,
+        opt_id="test_stats_single",
+    )
+    dopt1 = driver.dopt_init(single, verbose=False, initialize_strategy=True)
+    while dopt1.epoch_count < dopt1.n_epochs:
+        dopt1.run_epoch()
+    stats1 = dopt1.get_stats()
+    assert "model_init" in stats1 and "eval_sum" in stats1
+
+
 def test_time_limit_soft_stop():
     import time as _time
 

@@ -1,0 +1,443 @@
+"""Predictor-layer tests: regime parity against the frozen solve oracle,
+nystrom distillation gating, rank-k cache extension, telemetry, and the
+bitwise default-path trajectory pin.
+
+Oracle pattern: `gp_predict` (the ``solve`` regime) is bitwise-frozen —
+the ``matmul`` regime is pinned against it to tight f32 tolerance at
+every shape family the epoch loop produces (padded buckets, exact-bucket
+boundaries, d ∈ {1, 3}, post-rank-k appends), and the ``nystrom`` regime
+is bounded by its own distillation probe gate (a build that passes the
+gate may not exceed the gate's tolerances on the probe slab; a build
+that fails must serve matmul instead).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu.models import predictor as pr
+from dmosopt_tpu.models.gp import GPR_Matern, fit_gp_batch, gp_predict
+from dmosopt_tpu.models.predictor import (
+    GPPredictor,
+    build_nystrom_cache,
+    build_whitened_cache,
+    extend_whitened_rank_k,
+    gp_predict_matmul,
+    gp_predict_nystrom,
+)
+from dmosopt_tpu.models.refit import (
+    SurrogateRefitConfig,
+    SurrogateRefitController,
+)
+
+
+def _objective(x, d=2):
+    cols = [np.sum(x**2, axis=1), np.sum((x - 0.5) ** 2, axis=1),
+            np.sin(3.0 * x[:, 0]) + x[:, -1]]
+    return np.column_stack(cols[:d])
+
+
+def _pool(n, dim=5, d=2, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(size=(n, dim))
+    return X, _objective(X, d=d)
+
+
+FAST = {"n_starts": 2, "n_iter": 40, "seed": 0}
+
+
+def _assert_matmul_parity(fit, Xq, atol_mean=1e-5, rtol_var=5e-3,
+                          atol_var=1e-5):
+    """solve-vs-matmul at one fit: mean is the identical contraction
+    (near-bitwise), variance differs only by W·Ks vs back-substitution
+    reduction order."""
+    W = build_whitened_cache(fit)
+    m0, v0 = map(np.asarray, gp_predict(fit, Xq))
+    m1, v1 = map(np.asarray, gp_predict_matmul(fit, W, Xq))
+    np.testing.assert_allclose(m1, m0, atol=atol_mean, rtol=1e-5)
+    np.testing.assert_allclose(v1, v0, rtol=rtol_var, atol=atol_var)
+
+
+# ------------------------------------------------------------- regime parity
+
+
+@pytest.mark.parametrize(
+    "n,dim,d",
+    [
+        (90, 5, 2),   # padded 128 bucket
+        (64, 4, 2),   # exact bucket edge: no padded rows at all
+        (70, 3, 1),   # single objective
+        (100, 5, 3),  # three objectives
+    ],
+)
+def test_matmul_parity_across_shapes(n, dim, d):
+    X, Y = _pool(n, dim=dim, d=d)
+    Yn = (Y - Y.mean(0)) / Y.std(0)
+    X32 = jnp.asarray(X, jnp.float32)
+    from dmosopt_tpu.models.gp import _pad_to_bucket
+
+    Xp, Yp, mask = _pad_to_bucket(
+        X.astype(np.float32), Yn.astype(np.float32)
+    )
+    fit = fit_gp_batch(
+        jax.random.PRNGKey(0), jnp.asarray(Xp), jnp.asarray(Yp),
+        train_mask=jnp.asarray(mask), n_starts=2, n_iter=40,
+    )
+    Xq = jnp.asarray(
+        np.random.default_rng(3).uniform(size=(37, dim)), jnp.float32
+    )
+    _assert_matmul_parity(fit, Xq)
+
+
+def test_predictor_objects_route_and_agree():
+    """The surrogate-level `predictor=` knob routes `predict_normalized`
+    and all three regimes agree on the mean to the solve oracle's
+    accuracy class (nystrom may fall back — then it IS matmul)."""
+    dim = 5
+    X, Y = _pool(110, dim=dim)
+    mk = lambda **kw: GPR_Matern(
+        X, Y, dim, 2, np.zeros(dim), np.ones(dim), **FAST, **kw
+    )
+    solve, mm = mk(), mk(predictor="matmul")
+    Xq = jnp.asarray(
+        np.random.default_rng(1).uniform(size=(25, dim)), jnp.float32
+    )
+    m0, v0 = map(np.asarray, solve.predict_normalized(Xq))
+    m1, v1 = map(np.asarray, mm.predict_normalized(Xq))
+    assert solve.predictor_regime == "solve"
+    assert mm.predictor_regime == "matmul"
+    np.testing.assert_allclose(m1, m0, atol=1e-5)
+    np.testing.assert_allclose(v1, v0, rtol=5e-3, atol=1e-5)
+    # cache accounting: (d, P, P) f32
+    P = solve.fit.X.shape[0]
+    assert mm.build_predictor().cache_bytes() == 2 * P * P * 4
+
+
+def test_predictor_mode_validation():
+    dim = 3
+    X, Y = _pool(40, dim=dim)
+    with pytest.raises(ValueError, match="predictor"):
+        GPR_Matern(
+            X, Y, dim, 2, np.zeros(dim), np.ones(dim), **FAST,
+            predictor="cholesky",
+        )
+
+
+# ---------------------------------------------------------- nystrom gating
+
+
+def test_nystrom_full_rank_is_exact_and_passes_probe():
+    """m == N distillation reproduces the exact posterior (the Nyström
+    projection with Z = X is the identity on the training span) — the
+    probe passes and the nystrom regime serves."""
+    dim = 3
+    X, Y = _pool(60, dim=dim, seed=2)
+    sm = GPR_Matern(
+        X, Y, dim, 2, np.zeros(dim), np.ones(dim), **FAST,
+        predictor="nystrom", nystrom_points=4096,
+    )
+    p = sm.build_predictor()
+    assert sm.predictor_regime == "nystrom", p.distill_error
+    assert p.distill_error["ok"]
+    Xq = jnp.asarray(
+        np.random.default_rng(5).uniform(size=(30, dim)), jnp.float32
+    )
+    m0, v0 = map(np.asarray, gp_predict(sm.fit, Xq))
+    m2, v2 = map(np.asarray, sm.predict_normalized(Xq))
+    # full-rank distillation: errors bounded by the probe gate's own
+    # tolerances (far tighter in practice at m == N)
+    y_std = np.asarray(sm.fit.y_std)
+    assert np.max(np.abs(m2 - m0) / y_std[None, :]) <= 0.1
+    ratio = np.maximum(v2, 1e-10) / np.maximum(v0, 1e-10)
+    assert np.max(np.maximum(ratio, 1.0 / ratio)) <= 3.0
+
+
+def test_nystrom_probe_gates_fallback_to_matmul():
+    """A distillation the probe rejects must NOT serve: the predictor
+    falls back to matmul and predictions equal the matmul regime's."""
+    dim = 5
+    X, Y = _pool(120, dim=dim, seed=3)
+    sm = GPR_Matern(
+        X, Y, dim, 2, np.zeros(dim), np.ones(dim), **FAST,
+        predictor="nystrom", nystrom_points=12,  # far too few columns
+        nystrom_mean_tol=1e-4, nystrom_var_ratio_tol=1.01,  # strict gate
+    )
+    p = sm.build_predictor()
+    assert p.mode == "nystrom" and p.regime == "matmul"
+    assert p.distill_error is not None and not p.distill_error["ok"]
+    assert p.nystrom is None and p.whitened is not None
+    Xq = jnp.asarray(
+        np.random.default_rng(7).uniform(size=(20, dim)), jnp.float32
+    )
+    m2, v2 = map(np.asarray, sm.predict_normalized(Xq))
+    m1, v1 = map(
+        np.asarray, gp_predict_matmul(sm.fit, p.whitened, Xq)
+    )
+    np.testing.assert_array_equal(m2, m1)
+    np.testing.assert_array_equal(v2, v1)
+
+
+def test_nystrom_error_bounded_by_probe_gate():
+    """When the probe accepts, the served distillation respects the
+    gate's bounds on the probe slab — the property the gate certifies."""
+    dim = 2
+    X, Y = _pool(150, dim=dim, seed=4)
+    sm = GPR_Matern(
+        X, Y, dim, 2, np.zeros(dim), np.ones(dim), **FAST,
+        predictor="nystrom", nystrom_points=100,
+    )
+    p = sm.build_predictor()
+    if sm.predictor_regime != "nystrom":
+        pytest.skip(f"distillation rejected here: {p.distill_error}")
+    err = p.distill_error
+    assert err["ok"]
+    assert err["mean_err"] <= p._opts["nystrom_mean_tol"]
+    assert err["var_ratio"] <= p._opts["nystrom_var_ratio_tol"]
+
+
+# ------------------------------------------------------- rank-k composition
+
+
+def _drive(ctrl, X, Y, sizes, dim):
+    from dmosopt_tpu import moasmo
+
+    sm = None
+    for n in sizes:
+        sm = moasmo.train(
+            dim, 2, np.zeros(dim), np.ones(dim), X[:n], Y[:n], None,
+            surrogate_method_kwargs=dict(FAST, predictor="matmul"),
+            surrogate_refit=ctrl,
+        )
+    return sm
+
+
+def test_rank_update_extends_whitened_cache():
+    """A rank-k refit extends the previous epoch's whitening cache by
+    the block triangular-inverse identity; the extended cache matches a
+    from-scratch build of the new factor and the solve oracle."""
+    dim = 5
+    X, Y = _pool(140, dim=dim, seed=6)
+    ctrl = SurrogateRefitController(
+        SurrogateRefitConfig("warm", rank_update_after=0, audit_every=50)
+    )
+    sm0 = _drive(ctrl, X, Y, [100], dim)
+    # build the epoch's predictor the way moasmo.train does, then extend
+    assert sm0.build_predictor().whitened is not None
+    sm1 = _drive(ctrl, X, Y, [120], dim)
+    assert ctrl.path_history == ["cold", "rank"]
+    p1 = sm1._predictor_obj
+    assert p1 is not None, "rank path must carry the cache forward"
+    W_fresh = build_whitened_cache(sm1.fit)
+    np.testing.assert_allclose(
+        np.asarray(p1.whitened), np.asarray(W_fresh), rtol=2e-3, atol=2e-4
+    )
+    Xq = jnp.asarray(
+        np.random.default_rng(9).uniform(size=(30, dim)), jnp.float32
+    )
+    m0, v0 = map(np.asarray, gp_predict(sm1.fit, Xq, kernel=sm1.kernel))
+    m1, v1 = map(np.asarray, sm1.predict_normalized(Xq))
+    np.testing.assert_allclose(m1, m0, atol=1e-5)
+    np.testing.assert_allclose(v1, v0, rtol=1e-2, atol=1e-4)
+
+
+def test_extend_whitened_rank_k_matches_fresh_inverse():
+    """Kernel-level pin: the blocked W update equals the from-scratch
+    triangular inverse of the extended factor."""
+    dim = 4
+    n0, k = 70, 20
+    X, Y = _pool(n0 + k, dim=dim, seed=8)
+    Yn = (Y - Y.mean(0)) / Y.std(0)
+    from dmosopt_tpu.models.gp import _pad_to_bucket, extend_cholesky_rank_k
+
+    Xp, Yp, mask = _pad_to_bucket(
+        X[:n0].astype(np.float32), Yn[:n0].astype(np.float32)
+    )
+    fit = fit_gp_batch(
+        jax.random.PRNGKey(1), jnp.asarray(Xp), jnp.asarray(Yp),
+        train_mask=jnp.asarray(mask), n_starts=2, n_iter=30,
+    )
+    P = Xp.shape[0]
+    assert n0 + k <= P
+    X_pad = Xp.copy()
+    X_pad[n0 : n0 + k] = X[n0 : n0 + k].astype(np.float32)
+    mask2 = (np.arange(P) < n0 + k).astype(np.float32)
+    Yn_pad = np.zeros((P, 2), np.float32)
+    Yn_pad[: n0 + k] = Yn[: n0 + k].astype(np.float32)
+    L_new, _, _ = extend_cholesky_rank_k(
+        fit.L, jnp.asarray(X_pad), jnp.asarray(mask2), jnp.asarray(Yn_pad),
+        fit.amp, fit.ls, fit.noise, kernel="matern52",
+        n_old=n0, n_new=n0 + k, rel_jitter=1e-4,
+    )
+    W_old = build_whitened_cache(fit)
+    W_up = extend_whitened_rank_k(W_old, L_new, n_old=n0, n_new=n0 + k)
+    W_fresh = jax.vmap(
+        lambda L: jax.scipy.linalg.solve_triangular(
+            L, jnp.eye(P, dtype=L.dtype), lower=True
+        )
+    )(L_new)
+    np.testing.assert_allclose(
+        np.asarray(W_up), np.asarray(W_fresh), rtol=2e-3, atol=2e-4
+    )
+
+
+def test_clone_never_serves_stale_predictor():
+    """`clone_with_fit` drops the previous predictor object — a clone
+    with an updated posterior must rebuild, not serve the old cache."""
+    from dmosopt_tpu.models import gp
+
+    dim = 4
+    X, Y = _pool(80, dim=dim)
+    sm = GPR_Matern(
+        X, Y, dim, 2, np.zeros(dim), np.ones(dim), **FAST,
+        predictor="matmul",
+    )
+    sm.build_predictor()
+    clone = gp.clone_with_fit(sm, sm.fit, dict(sm.fit_info))
+    assert clone._predictor_obj is None
+    assert clone._predictor_spec == sm._predictor_spec
+
+
+# ----------------------------------------------------------------- telemetry
+
+
+class _Telemetry:
+    def __init__(self):
+        self.counters = {}
+        self.gauges = {}
+        self.observed = []
+        self.events = []
+
+    def __bool__(self):
+        return True
+
+    def inc(self, name, value=1.0, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        self.counters[key] = self.counters.get(key, 0.0) + value
+
+    def gauge(self, name, value, **labels):
+        self.gauges[name] = value
+
+    def observe(self, name, value, **labels):
+        self.observed.append((name, value))
+
+    def event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+def test_predictor_telemetry_and_hook_detach():
+    dim = 4
+    X, Y = _pool(70, dim=dim)
+    tel = _Telemetry()
+    pr.set_predictor_telemetry(tel)
+    try:
+        sm = GPR_Matern(
+            X, Y, dim, 2, np.zeros(dim), np.ones(dim), **FAST,
+            predictor="matmul",
+        )
+        sm.build_predictor()
+        key = ("gp_predictor_builds_total", (("regime", "matmul"),))
+        assert tel.counters[key] == 1
+        assert tel.gauges["gp_predictor_cache_bytes"] > 0
+        kinds = [k for k, _ in tel.events]
+        assert "gp_predictor" in kinds
+        Xq = jnp.asarray(
+            np.random.default_rng(2).uniform(size=(10, dim)), jnp.float32
+        )
+        sm.predict_normalized(Xq)  # eager: records predict latency
+        assert any(n == "gp_predict_seconds" for n, _ in tel.observed)
+    finally:
+        pr.set_predictor_telemetry(None)
+    # detached: further predicts record nothing
+    n_obs = len(tel.observed)
+    sm.predict_normalized(
+        jnp.asarray(np.random.default_rng(2).uniform(size=(4, dim)),
+                    jnp.float32)
+    )
+    assert len(tel.observed) == n_obs
+
+
+# -------------------------------------------------- default-path regression
+
+
+def test_default_solve_trajectory_bitwise_pinned():
+    """A seeded zdt1 driver run with the DEFAULT predictor (solve) is
+    bitwise-identical to the pre-predictor HEAD: the baked SHA-256 was
+    captured on the commit before the predictor layer landed (same
+    config, same host class, JAX_PLATFORMS=cpu). The solve regime is the
+    frozen program — any ulp drift here is a trajectory break."""
+    import dmosopt_tpu
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+
+    params = {
+        "opt_id": "predictor_traj_pin",
+        "obj_fun": zdt1,
+        "jax_objective": True,
+        "objective_names": ["f1", "f2"],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(6)},
+        "problem_parameters": {},
+        "n_initial": 4,
+        "n_epochs": 3,
+        "population_size": 24,
+        "num_generations": 12,
+        "resample_fraction": 0.5,
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": {"n_starts": 2, "n_iter": 40, "seed": 0},
+        "random_seed": 17,
+        "telemetry": False,
+    }
+    dmosopt_tpu.run(params, verbose=False)
+    from dmosopt_tpu.driver import dopt_dict
+
+    strat = dopt_dict["predictor_traj_pin"].optimizer_dict[0]
+    x, y = strat.x, strat.y
+    assert x.shape == (48, 6) and y.shape == (48, 2)
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(x.astype(np.float32)).tobytes())
+    h.update(np.ascontiguousarray(y.astype(np.float32)).tobytes())
+    assert h.hexdigest() == (
+        "f62934d055ddfeba411ec700253d6d73ffabd199969d85fc2e8ae21f23783867"
+    ), (float(np.sum(x.astype(np.float64))), float(np.sum(y.astype(np.float64))))
+
+
+def test_matmul_driver_run_matches_solve_quality():
+    """End-to-end: predictor="matmul" through the whole driver loop
+    lands the same solution-quality class as the default (the EA
+    consumes the cache for every generation; this is the e2e seam)."""
+    import dmosopt_tpu
+    from dmosopt_tpu.benchmarks.zdt import zdt1, zdt1_pareto, distance_to_front
+
+    def run(opt_id, predictor):
+        params = {
+            "opt_id": opt_id,
+            "obj_fun": zdt1,
+            "jax_objective": True,
+            "objective_names": ["f1", "f2"],
+            "space": {f"x{i}": [0.0, 1.0] for i in range(6)},
+            "problem_parameters": {},
+            "n_initial": 6,
+            "n_epochs": 3,
+            "population_size": 32,
+            "num_generations": 20,
+            "resample_fraction": 0.5,
+            "optimizer_name": "nsga2",
+            "surrogate_method_name": "gpr",
+            "surrogate_method_kwargs": {
+                "n_starts": 2, "n_iter": 40, "seed": 0,
+                "predictor": predictor,
+            },
+            "random_seed": 23,
+            "telemetry": False,
+        }
+        best = dmosopt_tpu.run(params, verbose=False)
+        _, lres = best
+        return np.column_stack([v for _, v in lres])
+
+    front = zdt1_pareto(300)
+    d_solve = float(np.median(distance_to_front(run("pred_e2e_s", "solve"), front)))
+    d_mm = float(np.median(distance_to_front(run("pred_e2e_m", "matmul"), front)))
+    assert d_mm <= max(2.0 * d_solve, 0.25), (d_mm, d_solve)

@@ -313,3 +313,76 @@ def test_front_fill_matches_rank_order():
             assert chosen[members].all()
         elif (counts[r - 1] if r else 0) >= popsize:
             assert not chosen[members].any()
+
+
+# --------------------------------------------- lorenz_smpso bench routing
+
+
+def test_smpso_biobjective_generation_routes_fast_rank(monkeypatch):
+    """Regression pin for the `lorenz_smpso_sec_per_gen` bench config
+    (bench.py config 5): the SMPSO generation program at the bench's
+    d == 2 shape family must trace through the O(N log N) bi-objective
+    rank sweep, never the dense dominance-matrix peel or the d >= 3
+    tiled sweep.
+
+    Context (investigated 2026-08-03): BENCH_r04/r05 recorded this
+    config at ~28 s/gen — the pre-PR-2 number — which looked like the
+    PR 2 fast path never landed. Re-measured in the bench child's own
+    environment on an idle host, the config runs at ~3.0 s/gen
+    (matching PR 2's claim): eval-only wall for the 40960 RK4
+    integrations of one generation is ~3.6 s, i.e. the config is
+    eval-bound and the SMPSO update is fully hidden. The r04/r05
+    numbers are host-contention artifacts (CMAES in the same rounds ran
+    3.6-4.6x its idle wall too). This pins the structural half — the
+    rank routing — so a rot here can't hide behind a noisy wall-clock
+    number again."""
+    import dmosopt_tpu.ops.dominance as dom
+    from dmosopt_tpu.optimizers import SMPSO
+
+    calls = {"sweep": 0, "tiled": 0, "peel": 0}
+    real_sweep = dom._rank_biobjective_sweep
+    real_tiled = dom._rank_tiled
+    real_peel = dom._rank_matrix_peel
+
+    def counting(name, real):
+        def fn(*a, **k):
+            calls[name] += 1
+            return real(*a, **k)
+
+        return fn
+
+    monkeypatch.setattr(
+        dom, "_rank_biobjective_sweep", counting("sweep", real_sweep)
+    )
+    monkeypatch.setattr(dom, "_rank_tiled", counting("tiled", real_tiled))
+    monkeypatch.setattr(
+        dom, "_rank_matrix_peel", counting("peel", real_peel)
+    )
+
+    # the bench family shrunk to test scale: 2 objectives, multi-swarm;
+    # an unusual popsize guarantees a fresh trace (counts are per-trace)
+    pop, dim, S = 11, 3, 2
+    rng = np.random.default_rng(0)
+    lb, ub = np.zeros(dim), np.ones(dim)
+    bounds = np.stack([lb, ub], 1)
+    x0 = rng.uniform(size=(pop * S, dim)).astype(np.float32)
+    y0 = np.column_stack(
+        [x0[:, 0], 1.0 - x0[:, 0] + x0[:, 1] ** 2]
+    ).astype(np.float32)
+    opt = SMPSO(popsize=pop, nInput=dim, nOutput=2, model=None, swarm_size=S)
+    opt.initialize_strategy(x0, y0, bounds, random=1)
+    assert calls["sweep"] > 0, "init sort must already ride the sweep"
+    assert calls["tiled"] == 0 and calls["peel"] == 0
+
+    calls.update(sweep=0, tiled=0, peel=0)
+
+    def gen(state, key):
+        x_gen, state = opt.generate_strategy(key, state)
+        y_gen = jnp.column_stack(
+            [x_gen[:, 0], 1.0 - x_gen[:, 0] + x_gen[:, 1] ** 2]
+        )
+        return opt.update_strategy(state, x_gen, y_gen)
+
+    jax.jit(gen)(opt.state, jax.random.PRNGKey(3))  # fresh trace
+    assert calls["sweep"] > 0, "generation update must ride the sweep"
+    assert calls["tiled"] == 0 and calls["peel"] == 0

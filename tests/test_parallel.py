@@ -189,6 +189,47 @@ def test_gp_fit_sharded_model_axis_matches_unsharded():
 
 
 @needs_devices
+def test_gp_predict_matmul_sharded_query_matches_unsharded():
+    """The matmul predictor's query-axis sharding constraint (the seam
+    the mesh-sharded inner EA loop rides) must not change results: same
+    fit, same queries, constrained vs unconstrained predict agree to
+    reduction-order tolerance, and the mesh-built predictor routes
+    through the constrained program."""
+    from dmosopt_tpu.models.gp import GPR_Matern, fit_gp_batch
+    from dmosopt_tpu.models.predictor import (
+        GPPredictor,
+        build_whitened_cache,
+        gp_predict_matmul,
+    )
+    from dmosopt_tpu.utils.prng import as_key
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rng = np.random.default_rng(4)
+    dim = 4
+    X = jnp.asarray(rng.random((56, dim)).astype(np.float32))
+    Y = np.stack([np.sin(2 * np.asarray(X[:, 0])), np.asarray(X).sum(1)], 1)
+    Y = jnp.asarray(((Y - Y.mean(0)) / Y.std(0)).astype(np.float32))
+    fit = fit_gp_batch(as_key(2), X, Y, n_starts=2, n_iter=30)
+    W = build_whitened_cache(fit)
+    Xq = jnp.asarray(rng.random((64, dim)).astype(np.float32))  # 8 | 64
+
+    mesh = create_mesh(8)
+    shard = NamedSharding(mesh, PartitionSpec("pop"))
+    m0, v0 = gp_predict_matmul(fit, W, Xq)
+    m1, v1 = gp_predict_matmul(fit, W, Xq, query_sharding=shard)
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m0), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(v1), np.asarray(v0), rtol=5e-3, atol=1e-5
+    )
+
+    p = GPPredictor(fit, "matern52", mode="matmul", mesh=mesh)
+    assert p._query_sharding is not None
+    m2, v2 = p.predict_normalized(Xq)
+    np.testing.assert_array_equal(np.asarray(m2), np.asarray(m1))
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(v1))
+
+
+@needs_devices
 def test_train_forwards_mesh_to_gp():
     """moasmo.train with a two-axis mesh forwards it into the exact-GP
     family (constructor names `mesh`) and the fit remains sound."""
