@@ -37,6 +37,12 @@ bench-gp:
 bench-predict:
 	env DMOSOPT_BENCH_ONLY=surrogate_predict python bench.py
 
+# the mesh-sharded GP fit alone (fit wall vs device count; sizes default
+# to {8k, 32k} on a real accelerator mesh and scale down on the CPU
+# fallback — override with DMOSOPT_BENCH_GP_SHARD_SIZES/_DEVICES)
+bench-gp-sharded:
+	env DMOSOPT_BENCH_ONLY=gp_sharded python bench.py
+
 # Warm .jax_bench_cache with the EXACT programs the round-end bench
 # compiles: one full bench pass, JSON line discarded. Run AFTER the last
 # code commit — any change to optimizer state layouts or jitted program

@@ -52,6 +52,37 @@ def _ensure_jax():
         import jax.numpy as _jnp
         np, jax, jnp = _np, _jax, _jnp
 
+
+def _json_default(o):
+    """`json.dumps` fallback coercing numpy/jax scalars and arrays to
+    plain Python values. BENCH_r03 died serializing a result dict that
+    held a device scalar — the conversion dispatched a jax op against an
+    unreachable backend — so every JSON exit in this file routes through
+    this duck-typed coercion (no numpy/jax import needed: the
+    orchestrator must stay jax-free)."""
+    for attr in ("tolist", "item"):
+        fn = getattr(o, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:
+                continue
+    raise TypeError(
+        f"Object of type {type(o).__name__} is not JSON serializable"
+    )
+
+
+def _dumps(result) -> str:
+    return json.dumps(result, default=_json_default)
+
+
+def _warn_loud(msg: str) -> None:
+    """Make backend/contention problems impossible to miss in the bench
+    log: r04/r05 silently ran on CPU fallback under 3-9x host-contention
+    wall inflation and the one-line notice was overlooked."""
+    bar = "!" * 72
+    print(f"{bar}\nbench: WARNING: {msg}\n{bar}", file=sys.stderr)
+
 # Config-1 constants re-measured 2026-07-30 (round 5) via
 # tools/refbench/measure_config1.py; 07-29 values (20.38 / 8.12 s)
 # reproduced within ~10%. NOTE: these were single-shot measurements;
@@ -831,6 +862,146 @@ def bench_pipeline_overlap():
     }
 
 
+_GP_SHARD_CHILD_SCRIPT = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+import jax.numpy as jnp
+{pin_cpu}from dmosopt_tpu.utils.compile_cache import enable_persistent_cache
+enable_persistent_cache({cache!r})
+from dmosopt_tpu.parallel.mesh import create_mesh
+from dmosopt_tpu.models import gp, gp_sharded
+from dmosopt_tpu.utils.prng import as_key
+
+N, ndev = {N}, {ndev}
+if len(jax.devices()) < ndev:
+    raise SystemExit(
+        "bench_gp_sharded: need %d devices, backend has %d — refusing to "
+        "measure a silently smaller mesh" % (ndev, len(jax.devices()))
+    )
+rng = np.random.default_rng(0)
+dim = 8
+X = jnp.asarray(rng.uniform(size=(N, dim)), jnp.float32)
+y = np.sin(3.0 * np.asarray(X[:, 0])) + np.asarray(X).sum(1)
+Y = jnp.asarray(((y - y.mean()) / y.std())[:, None], jnp.float32)
+mesh = create_mesh(ndev)
+kw = dict(n_starts=2, n_iter={n_iter}, convergence_tol=None)
+
+def timed(f):
+    jax.block_until_ready(f())  # compile + warm-up
+    t0 = time.time()
+    jax.block_until_ready(f())
+    return time.time() - t0
+
+res = dict(n=N, devices=ndev)
+res["sharded_fit_sec"] = round(timed(
+    lambda: gp_sharded.fit_gp_sharded(as_key(1), X, Y, mesh=mesh, **kw).nmll
+), 3)
+if ndev == 1:
+    res["single_device_fit_sec"] = round(timed(
+        lambda: gp.fit_gp_batch(as_key(1), X, Y, **kw).nmll
+    ), 3)
+print("RESULT=" + json.dumps(res))
+"""
+
+
+def bench_gp_sharded(sizes=None, device_counts=None):
+    """Config 10: mesh-sharded GP fit wall vs device count
+    (models/gp_sharded.py). Each (N, n_devices) cell runs in its own
+    subprocess because the device count must be fixed before backend
+    init (`xla_force_host_platform_device_count` on CPU; the first
+    `n_devices` real chips otherwise). The n_devices=1 cell also times
+    the single-device `fit_gp_batch` oracle — `speedup_vs_single` is
+    that wall over the sharded wall at the largest device count.
+
+    Sizing: the acceptance workload is N in {8k, 32k} on a real
+    8-device mesh. On the CPU fallback the "devices" are virtual (they
+    share the host's cores), so scaling numbers are comms-correctness
+    evidence, not speedup — sizes default down to keep the suite
+    bounded and the row is flagged `virtual_devices`. Override with
+    DMOSOPT_BENCH_GP_SHARD_SIZES / _DEVICES (comma-separated)."""
+    _ensure_jax()
+    platform = jax.default_backend()
+    virtual = platform == "cpu"
+    if sizes is None:
+        env = os.environ.get("DMOSOPT_BENCH_GP_SHARD_SIZES")
+        if env:
+            sizes = tuple(int(s) for s in env.split(","))
+        else:
+            sizes = (512, 1024) if virtual else (8192, 32768)
+    if device_counts is None:
+        env = os.environ.get("DMOSOPT_BENCH_GP_SHARD_DEVICES")
+        if env:
+            device_counts = tuple(int(s) for s in env.split(","))
+        else:
+            device_counts = (1, 8)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cache = os.path.join(repo, ".jax_bench_cache")
+    n_iter = 4 if virtual else 8
+    out = {
+        "platform": platform,
+        "virtual_devices": virtual,
+        "n_iter": n_iter,
+        "note": (
+            "virtual CPU devices share the host cores: scaling numbers "
+            "validate the collective program, not hardware speedup"
+        ) if virtual else "real-device mesh",
+    }
+    for N in sizes:
+        row = {}
+        for ndev in device_counts:
+            script = _GP_SHARD_CHILD_SCRIPT.format(
+                repo=repo, cache=cache, N=N, ndev=ndev, n_iter=n_iter,
+                pin_cpu=(
+                    "jax.config.update('jax_platforms', 'cpu')\n"
+                    if virtual else ""
+                ),
+            )
+            env = dict(os.environ)
+            if virtual:
+                env["JAX_PLATFORMS"] = "cpu"
+                env["PYTHONPATH"] = axon_free_pythonpath(repo)
+                flags = " ".join(
+                    f for f in env.get("XLA_FLAGS", "").split()
+                    if "xla_force_host_platform_device_count" not in f
+                )
+                env["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={ndev}"
+                ).strip()
+            proc = subprocess.Popen(
+                [sys.executable, "-c", script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, start_new_session=True,
+            )
+            child_s = float(
+                os.environ.get("DMOSOPT_BENCH_GP_SHARD_TIMEOUT", 900)
+            )
+            stdout, stderr, rc = communicate_bounded(proc, child_s)
+            cell = None
+            for line in reversed(stdout.strip().splitlines() or [""]):
+                if line.startswith("RESULT="):
+                    cell = json.loads(line.split("=", 1)[1])
+                    break
+            if cell is None:
+                row[f"devices_{ndev}"] = {
+                    "error": f"rc={rc}; stderr tail: {stderr[-400:]}"
+                }
+                continue
+            row[f"devices_{ndev}"] = {
+                k: v for k, v in cell.items() if k not in ("n", "devices")
+            }
+        single = row.get("devices_1", {}).get("single_device_fit_sec")
+        top = row.get(f"devices_{max(device_counts)}", {}).get(
+            "sharded_fit_sec"
+        )
+        if single and top:
+            row["speedup_vs_single"] = round(single / top, 2)
+        out[f"fit_n{N}"] = row
+    return {"gp_sharded": out}
+
+
 def _emit_partial(result):
     """Checkpoint the in-progress result dict so the orchestrator can
     salvage it if this measuring process dies or is killed mid-suite."""
@@ -839,7 +1010,7 @@ def _emit_partial(result):
         return
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
-        json.dump(result, fh)
+        fh.write(_dumps(result))
     os.replace(tmp, path)
 
 
@@ -865,6 +1036,14 @@ def child_main():
         "vs_baseline": 0.0,
         "configs": {},
         "device": str(jax.devices()[0]),
+        # self-identification (see orchestrate() for cpu_fallback and
+        # the end-of-run load reading): which backend actually measured,
+        # and how contended the host was when the suite started —
+        # without these, r04/r05's 3-9x contention-inflated CPU walls
+        # read as real regressions
+        "backend": jax.default_backend(),
+        "loadavg_start": [round(v, 2) for v in os.getloadavg()],
+        "cpu_count": os.cpu_count(),
     }
     _emit_partial(result)
 
@@ -887,7 +1066,7 @@ def child_main():
         st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(2), ngen, zdt1)
         jax.block_until_ready(st.population_obj)
         result.update(value=round(ngen / (time.time() - t0), 2), smoke=True)
-        print(json.dumps(result))
+        print(_dumps(result))
         return
 
     config_fns = {
@@ -899,6 +1078,7 @@ def child_main():
         "rank_throughput": bench_rank_throughput,
         "gp_refit": bench_gp_refit,
         "surrogate_predict": bench_surrogate_predict,
+        "gp_sharded": bench_gp_sharded,
     }
     only = os.environ.get("DMOSOPT_BENCH_ONLY")
     if only:
@@ -912,7 +1092,7 @@ def child_main():
             except Exception as e:
                 result["configs"][name] = {"error": f"{type(e).__name__}: {e}"}
             _emit_partial(result)
-        print(json.dumps(result))
+        print(_dumps(result))
         return
 
     gens_per_sec, gp_fit_sec, gp_fit_cold_sec, on_front = bench_zdt1_nsga2()
@@ -938,7 +1118,7 @@ def child_main():
             }
         _emit_partial(result)
 
-    print(json.dumps(result))
+    print(_dumps(result))
 
 
 # ------------------------------------------------------- orchestration
@@ -1025,9 +1205,10 @@ def orchestrate():
     if platform:
         print(f"bench: default backend is '{platform}'", file=sys.stderr)
     else:
-        print(
-            f"bench: default backend unreachable within {probe_s:.0f}s; "
-            f"falling back to JAX_PLATFORMS=cpu", file=sys.stderr,
+        _warn_loud(
+            f"default backend UNREACHABLE within {probe_s:.0f}s — falling "
+            f"back to JAX_PLATFORMS=cpu. Every wall below is a CPU "
+            f"number; do NOT compare it against accelerator baselines."
         )
 
     extra = {} if platform else _cpu_fallback_env()
@@ -1036,9 +1217,9 @@ def orchestrate():
     if result is None and platform:
         # backend probed fine but the suite still died on it (e.g. the
         # tunnel wedged mid-run) — one retry on the CPU fallback
-        print(
-            f"bench: suite failed on '{platform}' ({diag}); retrying on "
-            f"cpu", file=sys.stderr,
+        _warn_loud(
+            f"suite failed on '{platform}' ({diag}); retrying on cpu — "
+            f"the retried walls are CPU numbers"
         )
         device_mode = "cpu-fallback"
         result, diag = _run_measuring_child(
@@ -1057,7 +1238,22 @@ def orchestrate():
     if diag:
         result.setdefault("diagnostic", diag)
     result["device_mode"] = device_mode
-    print(json.dumps(result))
+    # contention/backend self-identification: record what actually ran
+    # and how loaded the host was, so a future reader never has to
+    # reverse-engineer whether a wall is comparable (r04/r05 were CPU-
+    # fallback runs under 3-9x contention and looked like regressions)
+    result["cpu_fallback"] = device_mode == "cpu-fallback"
+    result.setdefault("backend", platform or "cpu")
+    load_end = [round(v, 2) for v in os.getloadavg()]
+    result["loadavg_end"] = load_end
+    ncpu = os.cpu_count() or 1
+    if load_end[0] > 1.5 * ncpu:
+        _warn_loud(
+            f"host is CONTENDED (1-min loadavg {load_end[0]:.1f} on "
+            f"{ncpu} CPUs) — walls in this run may be inflated severalfold; "
+            f"re-measure on an idle host before trusting regressions"
+        )
+    print(_dumps(result))
 
 
 if __name__ == "__main__":

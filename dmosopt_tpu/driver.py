@@ -46,6 +46,7 @@ from dmosopt_tpu.parallel.evaluator import (
     HostFunEvaluator,
     JaxBatchEvaluator,
 )
+from dmosopt_tpu.models.gp_sharded import set_gp_shard_telemetry
 from dmosopt_tpu.models.predictor import set_predictor_telemetry
 from dmosopt_tpu.ops.dominance import set_rank_telemetry
 from dmosopt_tpu.parallel.pipeline import BackgroundWriter, PipelineConfig
@@ -1454,8 +1455,10 @@ def run(
     # never leak its registry into later eager ranking calls
     set_rank_telemetry(dopt.telemetry)
     # same span/teardown contract for the surrogate predictor layer's
-    # build/predict metrics (models/predictor.py)
+    # build/predict metrics (models/predictor.py) and the mesh-sharded
+    # GP fit's routing metrics (models/gp_sharded.py)
     set_predictor_telemetry(dopt.telemetry)
+    set_gp_shard_telemetry(dopt.telemetry)
     dopt.logger.info(f"Optimizing for {dopt.n_epochs} epochs...")
     body_ok = False
     try:
@@ -1509,6 +1512,7 @@ def run(
             # closed run's registry
             set_rank_telemetry(None)
             set_predictor_telemetry(None)
+            set_gp_shard_telemetry(None)
             # only close a Telemetry this run created: a pass-through
             # user-supplied instance may be shared across runs (one JSONL
             # sink for a sweep) and closing it would silently drop the

@@ -591,7 +591,11 @@ def train(
     """Fit the objective surrogate on feasible, deduplicated data
     (reference: dmosopt/MOASMO.py:473-532). A `mesh` is forwarded to
     surrogates whose constructor names it (the exact-GP family shards
-    its multi-start axis over the mesh's "model" axis when present).
+    its multi-start axis over the mesh's "model" axis when present;
+    with the opt-in ``surrogate_method_kwargs={"surrogate_mesh": ...}``
+    the whole hyperparameter fit runs as mesh-sharded tiled-Cholesky
+    stages over the population axis — see models/gp_sharded.py and
+    docs/parallel.md "Sharded surrogate fit").
 
     `info`, when given, is populated with training-set accounting
     (n_train, duplicates_removed, feasible_fraction, routed surrogate
@@ -708,6 +712,8 @@ def train(
             ("loss", "surrogate_loss"),
             ("n_steps", "fit_n_steps"),
             ("early_stopped", "fit_early_stopped"),
+            ("sharded", "fit_sharded"),
+            ("shard_devices", "fit_shard_devices"),
         ):
             if src in fit_info:
                 info[dst] = fit_info[src]

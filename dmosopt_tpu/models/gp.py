@@ -116,6 +116,13 @@ class GPFit(NamedTuple):
     train_mask: jax.Array  # (N,) 1 = real training row, 0 = bucket padding
     n_steps: Optional[jax.Array] = None  # () int32, Adam steps actually run
     best_start: Optional[jax.Array] = None  # (d,) winning restart index
+    # (d, N, N) whitening factor W = L⁻¹, populated only by the
+    # mesh-sharded fit (models/gp_sharded.py) whose final posterior pass
+    # produces it for free; the matmul predictor adopts it instead of
+    # re-paying the O(N³) inversion. Any posterior update that changes L
+    # must drop or extend it (see models/refit.py) — a stale W is the
+    # stale-predictor hazard in pytree form.
+    whitened: Optional[jax.Array] = None
 
 
 def _default_rel_jitter(dtype) -> float:
@@ -861,6 +868,37 @@ def _resolve_predictor_spec(
     )
 
 
+def _resolve_surrogate_mesh_spec(spec):
+    """Validate/normalize the exact-GP family's ``surrogate_mesh`` knob.
+
+    None/False (the default) disables the sharded fit entirely — the
+    single-device path stays byte-identical. True opts in with
+    defaults; a dict overrides ``min_points`` (archive-size routing
+    threshold, real rows), ``tile`` (Cholesky panel width, None =
+    `gp_sharded.default_chol_tile`) and ``axis`` (mesh axis name,
+    None = the mesh's first axis)."""
+    if spec is None or spec is False:
+        return None
+    out = {"min_points": 4096, "tile": None, "axis": None}
+    if spec is True:
+        return out
+    if isinstance(spec, dict):
+        unknown = sorted(set(spec) - set(out))
+        if unknown:
+            raise ValueError(
+                f"surrogate_mesh keys {unknown} not understood; "
+                f"expected a subset of {sorted(out)}"
+            )
+        out.update(spec)
+        out["min_points"] = int(out["min_points"])
+        if out["tile"] is not None:
+            out["tile"] = int(out["tile"])
+        return out
+    raise TypeError(
+        f"surrogate_mesh must be None, bool, or dict; got {type(spec)!r}"
+    )
+
+
 class SurrogateMixin:
     """Shared surrogate wrapper surface: unit-box x normalization and the
     reference's ``predict``/``evaluate`` contract on top of a jax-traceable
@@ -936,6 +974,7 @@ class GPR_Matern(SurrogateMixin):
         nystrom_mean_tol: float = 0.1,
         nystrom_var_ratio_tol: float = 3.0,
         mesh=None,
+        surrogate_mesh=None,
         logger=None,
         **kwargs,
     ):
@@ -947,10 +986,12 @@ class GPR_Matern(SurrogateMixin):
             nystrom_mean_tol, nystrom_var_ratio_tol,
         )
         self._mesh = mesh
+        self._shard_spec = _resolve_surrogate_mesh_spec(surrogate_mesh)
         self._predictor_obj = None
         X, Yn, y_mean, y_std = _prepare_training_data(
             self, xin, yin, nInput, nOutput, xlb, xub, nan, top_k
         )
+        n_real = X.shape[0]
 
         if anisotropic is None:
             anisotropic = self.anisotropic_default
@@ -977,11 +1018,7 @@ class GPR_Matern(SurrogateMixin):
                 jnp.asarray(w_ls, dt),
                 jnp.asarray(w_noise, dt),
             )
-        fit = fit_gp_batch(
-            key,
-            jnp.asarray(X, dt),
-            jnp.asarray(Yn, dt),
-            train_mask=jnp.asarray(tmask, dt),
+        common = dict(
             lengthscale_bounds=tuple(length_scale_bounds),
             amplitude_bounds=tuple(constant_kernel_bounds),
             noise_bounds=tuple(noise_level_bounds),
@@ -994,13 +1031,100 @@ class GPR_Matern(SurrogateMixin):
             convergence_tol=convergence_tol,
             convergence_check_every=convergence_check_every,
             warm_start=ws,
-            mesh=mesh,
         )
+        fit = shard_info = None
+        if self._shard_spec is not None and mesh is not None:
+            fit, shard_info = self._try_fit_sharded(
+                key, X, Yn, tmask, n_real, mesh, common
+            )
+        if fit is None:
+            fit = fit_gp_batch(
+                key,
+                jnp.asarray(X, dt),
+                jnp.asarray(Yn, dt),
+                train_mask=jnp.asarray(tmask, dt),
+                mesh=mesh,
+                **common,
+            )
         self.fit = fit._replace(
             y_mean=jnp.asarray(y_mean, dt),
             y_std=jnp.asarray(y_std, dt),
         )
         self.fit_info = _gp_fit_info(fit, n_iter)
+        if shard_info:
+            self.fit_info.update(shard_info)
+
+    def _try_fit_sharded(self, key, X, Yn, tmask, n_real, mesh, common):
+        """Route the hyperparameter fit through the mesh-sharded tiled
+        Cholesky (models/gp_sharded.py) when the ``surrogate_mesh`` spec,
+        the archive size, and the mesh/bucket shapes all allow it.
+
+        Probe discipline (mirrors the Nyström predictor's gate): a
+        sharded fit whose NMLL comes back non-finite is DISCARDED and
+        the caller falls back to the single-device fit — the routed
+        path may be slower to fail, never worse to serve. Returns
+        ``(fit | None, fit_info_extras | None)``."""
+        import time as _time
+
+        from dmosopt_tpu.models import gp_sharded
+
+        spec = self._shard_spec
+        P = X.shape[0]
+        axis = spec["axis"] or mesh.axis_names[0]
+        if n_real < spec["min_points"] or not gp_sharded.mesh_compatible(
+            mesh, axis, P
+        ):
+            return None, None
+        dt = self._dtype
+        tile = spec["tile"]
+        if tile is None or tile < 1 or P % tile:
+            # never crash the run on a tile that doesn't divide this
+            # bucket (archives grow across buckets; a user tile tuned
+            # for one bucket must degrade gracefully on the next)
+            if tile is not None and self.logger is not None:
+                self.logger.warning(
+                    f"surrogate_mesh: tile {tile} does not divide the "
+                    f"padding bucket {P}; using "
+                    f"{gp_sharded.default_chol_tile(P)}"
+                )
+            tile = gp_sharded.default_chol_tile(P)
+        n_devices = int(mesh.shape[axis])
+        t0 = _time.perf_counter()
+        fit = gp_sharded.fit_gp_sharded(
+            key,
+            jnp.asarray(X, dt),
+            jnp.asarray(Yn, dt),
+            train_mask=jnp.asarray(tmask, dt),
+            mesh=mesh,
+            shard_axis=axis,
+            tile=tile,
+            **common,
+        )
+        ok = bool(np.all(np.isfinite(np.asarray(fit.nmll))))
+        wall = _time.perf_counter() - t0
+        gp_sharded.record_sharded_fit(
+            ok, wall, n_devices, tile, n_real, P, int(Yn.shape[1])
+        )
+        if not ok:
+            if self.logger is not None:
+                self.logger.warning(
+                    f"surrogate_mesh: sharded fit at N={n_real} "
+                    f"(bucket {P}, {n_devices} devices) produced a "
+                    f"non-finite NMLL; falling back to the "
+                    f"single-device fit"
+                )
+            return None, None
+        if self._predictor_spec["mode"] == "solve":
+            # the solve predictor never reads W = L⁻¹ — holding the
+            # (d, P, P) factor alongside L would double the resident
+            # fit memory for nothing at exactly the archive scale this
+            # path exists to serve
+            fit = fit._replace(whitened=None)
+        return fit, {
+            "sharded": True,
+            "shard_devices": n_devices,
+            "shard_tile": tile,
+        }
 
     # jax-traceable prediction on unit-box-normalized input, routed
     # through the per-fit predictor (predictor="solve" — the default —
@@ -1017,6 +1141,16 @@ class GPR_Matern(SurrogateMixin):
                 rel_jitter=getattr(self, "_rel_jitter", None),
                 **self._predictor_spec,
             )
+            if (
+                self._predictor_obj.regime == "nystrom"
+                and getattr(self.fit, "whitened", None) is not None
+            ):
+                # a sharded fit's W = L⁻¹ was held only as the
+                # distillation-probe-failure matmul fallback; the probe
+                # passed, so release the (d, P, P) factor instead of
+                # keeping dead cache resident all epoch
+                self.fit = self.fit._replace(whitened=None)
+                self._predictor_obj.fit = self.fit
         return self._predictor_obj
 
     def build_predictor(self):

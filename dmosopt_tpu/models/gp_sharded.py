@@ -1,0 +1,627 @@
+"""Mesh-sharded exact-GP fitting: tiled blocked Cholesky as `shard_map`
+stages over the mesh population axis.
+
+After the predictor layer (PR 5) the GP *fit* is the dominant per-epoch
+cost (gp_fit_sec 2.8-13 s vs sub-second EA generations) and it runs on a
+single device: `fit_gp_batch`'s Adam loop factorizes the full (P, P)
+kernel every step with `jnp.linalg.cholesky`, which XLA executes on one
+chip however many the mesh has. The asynchronous tiled-Cholesky designs
+of GPRat (arXiv:2505.00136) and "GPU-Resident Gaussian Process
+Regression Leveraging Asynchronous Tasks with HPX" (PAPERS.md) split
+exactly this work: the kernel matrix is (B x B)-tiled, the panel factor
+is small and replicated, and the rank-B trailing update — where all the
+FLOPs are — is embarrassingly parallel across tile rows.
+
+This module is that design as explicit-collective `shard_map` programs,
+reusing the tiling discipline of `ops/dominance.py` (fixed-size tiles
+under `lax.scan`, one collective per tile, every device running the same
+SPMD program):
+
+- **Tiled right-looking blocked Cholesky** (`_chol_scan` inside the
+  factor body): the working matrix lives row-sharded — each device owns
+  a contiguous (P/n, P) slab. Per B-wide panel step: the current panel
+  rows are broadcast with one masked-scatter `psum`, every device
+  factorizes the tiny (B, B) diagonal block identically (the replicated
+  panel factor), solves its own slab's column block against it (the
+  panel triangular solve), and applies the rank-B trailing update to
+  its slab rows only — a local (P/n, B) x (B, P) matmul after one
+  `all_gather` of the (P, B) panel column. Per-device compare work is
+  P³/n; cross-device traffic is O(P²) total.
+
+- **Sharded triangular solves** for the whitening factor W = L⁻¹
+  (column-sharded forward substitution: each device solves its own
+  P/n identity columns, P³/2n work), from which ``alpha = Wᵀ(Wy)`` and
+  the NMLL follow with one `psum` + `all_gather` each.
+
+- **An analytic custom VJP** for the NMLL so the full hyperparameter
+  Adam loop of `fit_gp_batch` runs distributed: reverse-mode through a
+  scanned Cholesky would checkpoint every panel step (O(P³/B) residual
+  memory); instead the backward pass uses the exact-GP identity
+  dNMLL/dK = ½(K⁻¹ − ααᵀ) with K⁻¹ = WᵀW assembled row-sharded by a
+  ring of `ppermute` stages over W's column slabs (memory stays
+  O(P²/n) per device), then chains into the kernel hyperparameters
+  through a per-slab `jax.vjp` of the local kernel-row builder.
+
+`fit_gp_sharded` mirrors `fit_gp_batch`'s contract — same restart-grid
+initialization (identical RNG draws), same bounded reparameterization,
+same in-graph convergence stop, same `GPFit` result — so the
+single-device fit stays the oracle it is pinned against. The final
+posterior pass additionally returns the row-sharded whitened factor in
+``GPFit.whitened``, which `models/predictor.py` adopts directly for the
+matmul regime: predict throughput then scales with devices too, without
+re-paying the O(P³) inversion.
+
+Routing lives in `GPR_Matern.__init__` (models/gp.py): the sharded path
+is OPT-IN via ``surrogate_mesh=`` and gated by archive size
+(``min_points``) plus a post-fit finite-probe that falls back to the
+single-device fit rather than ever serving a failed factorization —
+the same probe/threshold discipline as the Nyström predictor. The
+default single-device path stays byte-identical.
+
+Telemetry rides the driver-attached process hook pattern of the rank
+and predictor layers (`set_gp_shard_telemetry`).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec
+
+from dmosopt_tpu.models.gp import (
+    _JITTER,
+    _KERNELS,
+    _LOG2PI,
+    _Bounds,
+    _default_rel_jitter,
+    _resolve_convergence_defaults,
+    _scan_with_convergence,
+    _select_better,
+    GPFit,
+    GPParams,
+)
+
+# Optional process-level telemetry hook (set by the driver), mirroring
+# `ops.dominance.set_rank_telemetry` / `predictor.set_predictor_telemetry`:
+# sharded fits record eagerly from the routing layer in models/gp.py —
+# the jitted programs themselves stay call-free.
+_TELEMETRY = None
+
+
+def set_gp_shard_telemetry(tel) -> None:
+    """Attach a `dmosopt_tpu.telemetry.Telemetry` (or None) to the
+    sharded-fit layer. Routed sharded fits then record
+    `gp_shard_fits_total`, `gp_shard_fallbacks_total`, the
+    `gp_shard_devices`/`gp_shard_tile_size` gauges and the
+    `gp_shard_fit_seconds` histogram. Process-global; the driver sets it
+    for the span of a run and clears it on teardown."""
+    global _TELEMETRY
+    _TELEMETRY = tel
+
+
+def record_sharded_fit(
+    ok: bool, wall_s: float, n_devices: int, tile: int, n_train: int,
+    bucket: int, d: int,
+) -> None:
+    """Host-side accounting for one routed sharded fit (called by the
+    routing layer in models/gp.py around the eager fit)."""
+    tel = _TELEMETRY
+    if not tel:
+        return
+    tel.inc("gp_shard_fits_total")
+    if not ok:
+        tel.inc("gp_shard_fallbacks_total")
+    tel.gauge("gp_shard_devices", float(n_devices))
+    tel.gauge("gp_shard_tile_size", float(tile))
+    tel.observe("gp_shard_fit_seconds", float(wall_s))
+    tel.event(
+        "gp_shard_fit", ok=bool(ok), n_devices=int(n_devices),
+        tile=int(tile), n_train=int(n_train), bucket=int(bucket),
+        n_objectives=int(d), wall_s=round(float(wall_s), 6),
+    )
+
+
+def default_chol_tile(P: int) -> int:
+    """Panel width for the tiled Cholesky: the largest power of two
+    <= 512 that divides ``P`` (bucket sizes are multiples of 64, so this
+    is >= 64 on every routed shape). 512 keeps each (B, B) panel factor
+    and (B, P) broadcast a few MB, same ceiling as the rank sweep's
+    tiles."""
+    b = 1
+    while b * 2 <= min(P, 512) and P % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def mesh_compatible(mesh, axis: str, P: int) -> bool:
+    """True when `fit_gp_sharded` can serve this (mesh, axis, P): the
+    axis exists and the padded size splits into whole per-device row
+    slabs. Routing falls back to the single-device fit otherwise."""
+    if mesh is None or axis not in mesh.axis_names:
+        return False
+    n_sh = int(mesh.shape[axis])
+    return n_sh >= 1 and P % n_sh == 0 and (P // n_sh) >= 1
+
+
+# ------------------------------------------------------- shard_map bodies
+
+
+@lru_cache(maxsize=64)
+def _programs(mesh, axis: str, P: int, B: int, kernel: str,
+              rel_jitter: float):
+    """Compile-cached builders for the sharded factor programs.
+
+    Returns ``(nmll_vjp, posterior)``:
+
+    - ``nmll_vjp(amp, ls, noise, X, m, y) -> nmll`` — the scalar exact
+      NMLL with an analytic custom VJP (gradients w.r.t. amp/ls/noise
+      and y; zeros for X/m). This is what the distributed Adam loop
+      differentiates.
+    - ``posterior(amp, ls, noise, X, m, y) -> (L, W, alpha, nmll)`` —
+      the final factorization at fixed hyperparameters: L row-sharded
+      (P, P), W = L⁻¹ row-sharded (the predictor's whitening factor),
+      alpha (P,), nmll ().
+
+    ``y`` must already be zeroed on masked rows (the same contract as
+    `gp._nmll`).
+    """
+    kernel_fn = _KERNELS[kernel]
+    n_sh = int(mesh.shape[axis])
+    L_loc = P // n_sh
+    T = P // B
+    if P % n_sh or P % B:
+        raise ValueError(
+            f"sharded GP fit needs P divisible by both the mesh axis "
+            f"({n_sh}) and the tile ({B}); got P={P}"
+        )
+
+    def k_rows(p, gidx, amp, ls, noise, X, m):
+        """My slab's rows of the masked, regularized kernel — the same
+        matrix `gp._apply_train_mask(gp._regularized_kernel(...))`
+        builds dense, constructed (L_loc, P) local. No explicit
+        symmetrization: `_scaled_sqdist`'s row/col expressions for
+        (i, j) and (j, i) are the same fp additions and identically
+        ordered dot products, so the dense path's 0.5(K + Kᵀ) is an fp
+        no-op."""
+        dt = X.dtype
+        Xs = jax.lax.dynamic_slice_in_dim(X, p * L_loc, L_loc)
+        m_loc = jax.lax.dynamic_slice_in_dim(m, p * L_loc, L_loc)
+        K = kernel_fn(Xs, X, ls, amp) * (m_loc[:, None] * m[None, :])
+        jitter = _JITTER + rel_jitter * amp
+        eye = (jnp.arange(P)[None, :] == gidx[:, None]).astype(dt)
+        return K + eye * (
+            (noise + jitter) * m_loc[:, None] + (1.0 - m_loc[:, None])
+        )
+
+    def extract_rows(A_loc, gidx, off, dt):
+        """Broadcast rows [off, off+B) of the row-sharded matrix: each
+        device scatters its owned rows of the window into a zero (B, P)
+        block; `psum` over disjoint contributions assembles the panel
+        on every device (the replicated panel of the blocked designs)."""
+        rel = gidx - off
+        sel = ((rel >= 0) & (rel < B)).astype(dt)
+        contrib = jnp.zeros((B, P), dt).at[jnp.clip(rel, 0, B - 1)].add(
+            A_loc * sel[:, None]
+        )
+        return jax.lax.psum(contrib, axis)
+
+    def chol_scan(K_loc, gidx):
+        """Right-looking blocked Cholesky over T = P/B panel steps; the
+        carry is my (L_loc, P) slab of the working matrix. Finalized
+        entries accumulate in the lower triangle; the stale upper-
+        triangle Schur values are masked off at the end."""
+        dt = K_loc.dtype
+
+        def step(A_loc, t):
+            off = t * B
+            panel = extract_rows(A_loc, gidx, off, dt)  # (B, P)
+            Kjj = jax.lax.dynamic_slice(panel, (0, off), (B, B))
+            Ljj = jnp.linalg.cholesky(Kjj)  # replicated panel factor
+            C_loc = jax.lax.dynamic_slice(A_loc, (0, off), (L_loc, B))
+            # panel triangular solve: L[i, off:off+B] = A[i, ..] Ljj⁻ᵀ
+            Lcol = jax.scipy.linalg.solve_triangular(
+                Ljj, C_loc.T, lower=True
+            ).T  # (L_loc, B)
+            rel = gidx - off
+            in_panel = (rel >= 0) & (rel < B)
+            trailing = gidx >= off + B
+            newcol = jnp.where(
+                in_panel[:, None], Ljj[jnp.clip(rel, 0, B - 1)], Lcol
+            )
+            newcol = jnp.where(
+                (in_panel | trailing)[:, None], newcol, C_loc
+            )
+            A_loc = jax.lax.dynamic_update_slice(A_loc, newcol, (0, off))
+            # rank-B trailing update, local to my tile rows: the full
+            # (P, B) panel column arrives by one all_gather (rows
+            # outside the trailing block zeroed, so already-final
+            # columns are never touched)
+            Lfull = jax.lax.all_gather(
+                jnp.where(trailing[:, None], Lcol, jnp.zeros_like(Lcol)),
+                axis, axis=0, tiled=True,
+            )  # (P, B)
+            upd = jnp.matmul(Lcol, Lfull.T, precision="highest")
+            A_loc = A_loc - upd * trailing[:, None].astype(dt)
+            return A_loc, None
+
+        A_loc, _ = jax.lax.scan(step, K_loc, jnp.arange(T))
+        return A_loc * (jnp.arange(P)[None, :] <= gidx[:, None]).astype(
+            A_loc.dtype
+        )
+
+    def whiten_scan(L_slab, gidx, p):
+        """Column-sharded blocked forward substitution for W = L⁻¹:
+        each device solves L @ W[:, cols_p] = I[:, cols_p] for its own
+        P/n identity columns, consuming the same broadcast panels as
+        the factorization. Returns my (P, L_loc) column slab."""
+        dt = L_slab.dtype
+        mycols = p * L_loc + jnp.arange(L_loc)
+
+        def step(Wc, t):
+            off = t * B
+            panel = extract_rows(L_slab, gidx, off, dt)  # (B, P)
+            Ljj = jax.lax.dynamic_slice(panel, (0, off), (B, B))
+            done = (jnp.arange(P) < off).astype(dt)
+            rhs = ((off + jnp.arange(B))[:, None] == mycols[None, :]).astype(dt)
+            rhs = rhs - jnp.matmul(
+                panel * done[None, :], Wc, precision="highest"
+            )
+            Wb = jax.scipy.linalg.solve_triangular(Ljj, rhs, lower=True)
+            return jax.lax.dynamic_update_slice(Wc, Wb, (off, 0)), None
+
+        Wc, _ = jax.lax.scan(step, jnp.zeros((P, L_loc), dt), jnp.arange(T))
+        return Wc
+
+    def solve_stats(Wc, p, gidx, L_slab, m, y):
+        """alpha = Wᵀ(Wy) and the NMLL from the factored pieces: one
+        (P,) psum for u = Wy, one tiled all_gather for alpha, one
+        scalar psum for the log-determinant."""
+        y_loc = jax.lax.dynamic_slice_in_dim(y, p * L_loc, L_loc)
+        u = jax.lax.psum(Wc @ y_loc, axis)  # (P,) = W y
+        alpha = jax.lax.all_gather(Wc.T @ u, axis, axis=0, tiled=True)
+        diag = jnp.take_along_axis(L_slab, gidx[:, None], axis=1)[:, 0]
+        logdet = jax.lax.psum(jnp.sum(jnp.log(diag)), axis)
+        n_eff = jnp.sum(m)
+        nmll = 0.5 * jnp.dot(y, alpha) + logdet + 0.5 * n_eff * _LOG2PI
+        return alpha, nmll
+
+    def factor_pieces(amp, ls, noise, X, m, y):
+        p = jax.lax.axis_index(axis)
+        gidx = p * L_loc + jnp.arange(L_loc)
+        K_loc = k_rows(p, gidx, amp, ls, noise, X, m)
+        L_slab = chol_scan(K_loc, gidx)
+        Wc = whiten_scan(L_slab, gidx, p)
+        alpha, nmll = solve_stats(Wc, p, gidx, L_slab, m, y)
+        return nmll, Wc, alpha, L_slab
+
+    def fwd_body(amp, ls, noise, X, m, y):
+        nmll, Wc, alpha, _ = factor_pieces(amp, ls, noise, X, m, y)
+        return nmll, Wc, alpha
+
+    def post_body(amp, ls, noise, X, m, y):
+        nmll, Wc, alpha, L_slab = factor_pieces(amp, ls, noise, X, m, y)
+        # column-sharded W -> row-sharded W (the predict layout: each
+        # device then computes ‖W Ks‖² over its own rows with only an
+        # (M,)-sized psum left for the variance)
+        if n_sh > 1:
+            Wr = jax.lax.all_to_all(
+                Wc, axis, split_axis=0, concat_axis=1, tiled=True
+            )  # (L_loc, P)
+        else:
+            Wr = Wc
+        return nmll, alpha, L_slab, Wr
+
+    def bwd_body(amp, ls, noise, X, m, Wc, alpha):
+        """Row-sharded Ḡ = ½(K⁻¹ − ααᵀ) with K⁻¹ = WᵀW assembled by a
+        ring of ppermute stages over W's column slabs, then the chain
+        into (amp, ls, noise) through a vjp of the local kernel rows."""
+        p = jax.lax.axis_index(axis)
+        gidx = p * L_loc + jnp.arange(L_loc)
+        dt = X.dtype
+        if n_sh > 1:
+            perm = [(i, (i + 1) % n_sh) for i in range(n_sh)]
+
+            def ring(carry, s):
+                block, Kinv = carry
+                q = (p - s) % n_sh  # owner of the visiting slab
+                part = jnp.matmul(Wc.T, block, precision="highest")
+                Kinv = jax.lax.dynamic_update_slice(
+                    Kinv, part, (0, q * L_loc)
+                )
+                block = jax.lax.ppermute(block, axis, perm)
+                return (block, Kinv), None
+
+            (_, Kinv_loc), _ = jax.lax.scan(
+                ring, (Wc, jnp.zeros((L_loc, P), dt)), jnp.arange(n_sh)
+            )
+        else:
+            Kinv_loc = jnp.matmul(Wc.T, Wc, precision="highest")
+        a_loc = jax.lax.dynamic_slice_in_dim(alpha, p * L_loc, L_loc)
+        G = 0.5 * (Kinv_loc - a_loc[:, None] * alpha[None, :])
+        _, vjp = jax.vjp(
+            lambda a_, l_, n_: k_rows(p, gidx, a_, l_, n_, X, m),
+            amp, ls, noise,
+        )
+        ga, gl, gn = vjp(G)
+        return (
+            jax.lax.psum(ga, axis),
+            jax.lax.psum(gl, axis),
+            jax.lax.psum(gn, axis),
+        )
+
+    repl = PartitionSpec()
+    rows = PartitionSpec(axis)
+    cols = PartitionSpec(None, axis)
+
+    fwd_prog = shard_map(
+        fwd_body, mesh=mesh, in_specs=(repl,) * 6,
+        out_specs=(repl, cols, repl), check_rep=False,
+    )
+    post_prog = shard_map(
+        post_body, mesh=mesh, in_specs=(repl,) * 6,
+        out_specs=(repl, repl, rows, rows), check_rep=False,
+    )
+    bwd_prog = shard_map(
+        bwd_body, mesh=mesh, in_specs=(repl,) * 5 + (cols, repl),
+        out_specs=(repl, repl, repl), check_rep=False,
+    )
+
+    @jax.custom_vjp
+    def nmll_vjp(amp, ls, noise, X, m, y):
+        nmll, _, _ = fwd_prog(amp, ls, noise, X, m, y)
+        return nmll
+
+    def nmll_fwd(amp, ls, noise, X, m, y):
+        nmll, Wc, alpha = fwd_prog(amp, ls, noise, X, m, y)
+        return nmll, (amp, ls, noise, X, m, y, Wc, alpha)
+
+    def nmll_bwd(res, g):
+        amp, ls, noise, X, m, y, Wc, alpha = res
+        ga, gl, gn = bwd_prog(amp, ls, noise, X, m, Wc, alpha)
+        # dNMLL/dy = alpha (the quadratic term's gradient; K⁻¹y = α)
+        return (
+            g * ga, g * gl, g * gn,
+            jnp.zeros_like(X), jnp.zeros_like(m), g * alpha,
+        )
+
+    nmll_vjp.defvjp(nmll_fwd, nmll_bwd)
+    return nmll_vjp, post_prog
+
+
+def nmll_sharded(
+    amp, ls, noise, X, train_mask, y, *, mesh, shard_axis: str = "pop",
+    tile: Optional[int] = None, kernel: str = "matern52",
+    rel_jitter: Optional[float] = None,
+):
+    """Scalar exact NMLL of one objective's GP, computed mesh-sharded,
+    differentiable w.r.t. (amp, ls, noise, y) through the analytic
+    custom VJP. ``y`` must be zeroed on masked rows. The non-sharded
+    oracle is `gp._nmll` (pinned by tests/test_gp_sharded.py)."""
+    P = X.shape[0]
+    if rel_jitter is None:
+        rel_jitter = _default_rel_jitter(X.dtype)
+    B = int(tile) if tile is not None else default_chol_tile(P)
+    fn, _ = _programs(mesh, shard_axis, P, B, kernel, float(rel_jitter))
+    return fn(amp, ls, noise, X, train_mask, y)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("kernel", "rel_jitter", "mesh", "shard_axis", "tile"),
+)
+def posterior_sharded(
+    X: jax.Array,  # (P, n)
+    Yn: jax.Array,  # (P, d) standardized targets, zero on masked rows
+    train_mask: jax.Array,  # (P,)
+    amp: jax.Array,  # (d,)
+    ls: jax.Array,  # (d, L)
+    noise: jax.Array,  # (d,)
+    kernel: str = "matern52",
+    rel_jitter: Optional[float] = None,
+    *,
+    mesh,
+    shard_axis: str = "pop",
+    tile: Optional[int] = None,
+):
+    """Masked factorization at fixed hyperparameters, mesh-sharded — the
+    distributed analogue of `gp.posterior_from_params`, which is the
+    oracle it is pinned against. Returns ``(L, W, alpha, nmll)`` with
+    shapes ((d, P, P), (d, P, P), (d, P), (d,)); L and W arrive
+    row-sharded over ``shard_axis``."""
+    P = X.shape[0]
+    if rel_jitter is None:
+        rel_jitter = _default_rel_jitter(X.dtype)
+    B = int(tile) if tile is not None else default_chol_tile(P)
+    _, post = _programs(mesh, shard_axis, P, B, kernel, float(rel_jitter))
+    Ym = Yn * train_mask[:, None].astype(Yn.dtype)
+
+    def one(args):
+        a_i, l_i, n_i, y = args
+        nmll, alpha, L, W = post(a_i, l_i, n_i, X, train_mask, y)
+        return L, W, alpha, nmll
+
+    return jax.lax.map(one, (amp, ls, noise, Ym.T))
+
+
+# --------------------------------------------------------- the fit loop
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "kernel", "n_starts", "n_iter", "ard", "rel_jitter",
+        "mesh", "shard_axis", "tile",
+        "convergence_tol", "convergence_check_every",
+    ),
+)
+def fit_gp_sharded(
+    key: jax.Array,
+    X: jax.Array,  # (P, n) unit box (possibly bucket-padded)
+    Y: jax.Array,  # (P, d) standardized targets
+    lengthscale_bounds: Tuple[float, float] = (1e-3, 100.0),
+    amplitude_bounds: Tuple[float, float] = (1e-4, 1e3),
+    noise_bounds: Tuple[float, float] = (1e-9, 1e-2),
+    kernel: str = "matern52",
+    n_starts: int = 8,
+    n_iter: int = 200,
+    learning_rate: float = 0.1,
+    ard: bool = False,
+    rel_jitter: Optional[float] = None,
+    train_mask: Optional[jax.Array] = None,
+    mesh=None,
+    shard_axis: str = "pop",
+    tile: Optional[int] = None,
+    convergence_tol="auto",
+    convergence_check_every: Optional[int] = None,
+    warm_start: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+) -> GPFit:
+    """`fit_gp_batch` with the N-axis work mesh-sharded: same restart
+    grid (identical RNG draws and bounded reparameterization), same Adam
+    optimizer and in-graph convergence stop, but every NMLL evaluation
+    and gradient runs as the tiled shard_map programs of `_programs` —
+    the (P, P) kernel never materializes on one device.
+
+    The (S, d) restart-objective grid is walked SEQUENTIALLY
+    (`lax.map`) rather than batched: the sharded path serves large
+    archives, where one (P, P) working set per device is the memory
+    budget; batching the grid would multiply it by S·d for no wall
+    gain once each factorization already spans the mesh.
+
+    Returns a `GPFit` whose ``L`` (and the extra ``whitened`` factor
+    W = L⁻¹, the matmul predictor's cache) arrive row-sharded over
+    ``shard_axis``; downstream consumers see ordinary arrays. Numerical
+    parity with `fit_gp_batch` is reduction-order-level, not bitwise —
+    the routing layer keeps the default single-device path untouched.
+    """
+    if mesh is None:
+        raise ValueError("fit_gp_sharded requires a mesh")
+    P, n = X.shape
+    if train_mask is not None:
+        Y = Y * train_mask[:, None].astype(Y.dtype)
+    d = Y.shape[1]
+    convergence_tol, convergence_check_every = _resolve_convergence_defaults(
+        d, convergence_tol, convergence_check_every
+    )
+    Lls = n if ard else 1
+    dt = X.dtype
+    if rel_jitter is None:
+        rel_jitter = _default_rel_jitter(dt)
+    B = int(tile) if tile is not None else default_chol_tile(P)
+    nmll_fn, post = _programs(
+        mesh, shard_axis, P, B, kernel, float(rel_jitter)
+    )
+    tm = jnp.ones((P,), dt) if train_mask is None else train_mask.astype(dt)
+
+    b_amp = _Bounds(jnp.asarray(amplitude_bounds[0], dt), jnp.asarray(amplitude_bounds[1], dt))
+    b_ls = _Bounds(jnp.asarray(lengthscale_bounds[0], dt), jnp.asarray(lengthscale_bounds[1], dt))
+    b_noise = _Bounds(jnp.asarray(noise_bounds[0], dt), jnp.asarray(noise_bounds[1], dt))
+
+    # restart-grid initialization: verbatim `fit_gp_batch` (same key
+    # splits, same draw shapes, same warm-start anchoring) so the two
+    # fits start from identical points and parity is meaningful
+    k1, k2, k3 = jax.random.split(key, 3)
+    if warm_start is None:
+        u0_amp = jnp.full((n_starts, d), b_amp.inverse(jnp.asarray(1.0, dt)))
+        u0_ls = jnp.full((n_starts, d, Lls), b_ls.inverse(jnp.asarray(0.5, dt)))
+        u0_noise = jnp.full((n_starts, d), b_noise.inverse(jnp.asarray(1e-6, dt)))
+    else:
+        w_amp, w_ls, w_noise = warm_start
+        u0_amp = jnp.broadcast_to(
+            b_amp.inverse(jnp.asarray(w_amp, dt)), (n_starts, d)
+        )
+        u0_ls = jnp.broadcast_to(
+            b_ls.inverse(jnp.asarray(w_ls, dt)), (n_starts, d, Lls)
+        )
+        u0_noise = jnp.broadcast_to(
+            b_noise.inverse(jnp.asarray(w_noise, dt)), (n_starts, d)
+        )
+    jitter_amp = 2.0 * jax.random.normal(k1, (n_starts, d), dt)
+    jitter_ls = 2.0 * jax.random.normal(k2, (n_starts, d, Lls), dt)
+    jitter_noise = 2.0 * jax.random.normal(k3, (n_starts, d), dt)
+    mask = (jnp.arange(n_starts) > 0).astype(dt)
+    params0 = GPParams(
+        u_amp=u0_amp + mask[:, None] * jitter_amp,
+        u_ls=u0_ls + mask[:, None, None] * jitter_ls,
+        u_noise=u0_noise + mask[:, None] * jitter_noise,
+    )
+
+    Yt = jnp.broadcast_to(Y.T[None], (n_starts, d, P)).reshape(
+        n_starts * d, P
+    )
+
+    def grid_vals_grads(params: GPParams):
+        flat = (
+            params.u_amp.reshape(n_starts * d),
+            params.u_ls.reshape(n_starts * d, Lls),
+            params.u_noise.reshape(n_starts * d),
+            Yt,
+        )
+
+        def one(args):
+            ua, ul, un, y = args
+
+            def loss(ua_, ul_, un_):
+                amp = b_amp.forward(ua_)
+                ls = b_ls.forward(ul_)
+                noise = b_noise.forward(un_)
+                return nmll_fn(amp, ls, noise, X, tm, y)
+
+            return jax.value_and_grad(loss, argnums=(0, 1, 2))(ua, ul, un)
+
+        vals_f, (ga, gl, gn) = jax.lax.map(one, flat)
+        vals = vals_f.reshape(n_starts, d)
+        grads = GPParams(
+            u_amp=ga.reshape(n_starts, d),
+            u_ls=gl.reshape(n_starts, d, Lls),
+            u_noise=gn.reshape(n_starts, d),
+        )
+        return vals, grads
+
+    opt = optax.adam(learning_rate)
+    opt_state0 = opt.init(params0)
+    inf0 = jnp.full((n_starts, d), jnp.inf, dt)
+
+    def step(carry, _):
+        params, opt_state, best_params, best_vals = carry
+        vals, grads = grid_vals_grads(params)
+        vals = jnp.where(jnp.isfinite(vals), vals, jnp.inf)
+        improved = vals < best_vals
+        best_params = _select_better(improved, params, best_params)
+        best_vals = jnp.where(improved, vals, best_vals)
+        grads = jax.tree_util.tree_map(jnp.nan_to_num, grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, best_params, best_vals), None
+
+    (_, _, params, final), n_steps = _scan_with_convergence(
+        step, (params0, opt_state0, params0, inf0), n_iter,
+        convergence_tol, convergence_check_every,
+        lambda best_vals: jnp.min(best_vals, axis=0), dt,
+    )
+    best = jnp.argmin(final, axis=0)  # (d,)
+    take = lambda arr: jnp.take_along_axis(
+        arr, best.reshape((1, d) + (1,) * (arr.ndim - 2)), axis=0
+    )[0]
+    amp = b_amp.forward(take(params.u_amp))
+    ls = b_ls.forward(take(params.u_ls))
+    noise = b_noise.forward(take(params.u_noise))
+
+    def post_one(args):
+        a_i, l_i, n_i, y = args
+        _, alpha, L, W = post(a_i, l_i, n_i, X, tm, y)
+        return L, W, alpha
+
+    L, W, alpha = jax.lax.map(post_one, (amp, ls, noise, Y.T))
+    nmll = jnp.min(final, axis=0)
+    zeros = jnp.zeros((d,), dt)
+    return GPFit(
+        X=X, L=L, alpha=alpha, amp=amp, ls=ls, noise=noise,
+        y_mean=zeros, y_std=jnp.ones((d,), dt), nmll=nmll,
+        train_mask=tm, n_steps=n_steps, best_start=best, whitened=W,
+    )

@@ -376,6 +376,14 @@ class GPPredictor:
             if self._build_nystrom():
                 return
             self.regime = "matmul"  # probe-gated fallback
+        # a mesh-sharded fit (models/gp_sharded.py) already carries
+        # W = L⁻¹ — its posterior pass produces the factor row-sharded
+        # for free — so adopt it instead of re-paying the O(N³)
+        # inversion; predict then scales over the mesh too (row-sharded
+        # W leaves only an (M,)-sized collective in the variance)
+        if getattr(self.fit, "whitened", None) is not None:
+            self.whitened = jax.block_until_ready(self.fit.whitened)
+            return
         # sync before the build timer stops: without it an async backend
         # returns a dispatched-but-unfinished cache — build_s would read
         # ~0 and the O(N³) compute would land in the first EA generation,

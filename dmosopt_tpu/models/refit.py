@@ -41,6 +41,13 @@ the ``rank`` path for free: stragglers land as appended archive rows at
 the next drain, so a stable surrogate absorbs them (plus the resample
 batch) through the same rank-k extension.
 
+Mesh-sharded fits (``surrogate_mesh=``, models/gp_sharded.py) compose
+too: their `GPFit.L` is an ordinary (row-sharded) array, so the rank-k
+extension and the fixed-hyperparameter refactorization apply unchanged;
+the extra ``GPFit.whitened`` factor they carry is tied to the old L and
+is dropped on every posterior update here (the predictor layer extends
+or rebuilds its own whitening cache).
+
 State is host-small (per-objective hyperparameter vectors plus one
 reference to the previous fitted model, whose `(d, P, P)` factor stays
 device-resident anyway) and exports to a JSON-able dict so resumed runs
@@ -517,10 +524,14 @@ class SurrogateRefitController:
                 n_old=n_old, n_new=n_new, rel_jitter=rel_jitter,
             )
             path = "rank"
+            # whitened (the sharded fit's W = L⁻¹) is tied to the OLD
+            # factor — drop it; the predictor layer extends or rebuilds
+            # its own whitening cache for the new posterior
             fit = prev.fit._replace(
                 X=jnp.asarray(X_pad), L=L, alpha=alpha, nmll=nmll,
                 train_mask=jnp.asarray(mask),
                 n_steps=jnp.asarray(0, jnp.int32),
+                whitened=None,
             )
         else:
             # bucket boundary crossed: re-pad and refactorize at the
@@ -539,6 +550,7 @@ class SurrogateRefitController:
                 X=jnp.asarray(X_pad), L=L, alpha=alpha, nmll=nmll,
                 train_mask=jnp.asarray(mask.astype(dt_np)),
                 n_steps=jnp.asarray(0, jnp.int32),
+                whitened=None,  # tied to the old factor (see above)
             )
 
         nmll_np = np.asarray(nmll, dtype=np.float64)

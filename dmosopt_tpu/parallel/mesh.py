@@ -21,6 +21,14 @@ SURVEY §2.2/§5.8). The TPU-native equivalents provided here:
   lex-sorted population against the current tile and a single `pmax`
   merges the per-device longest-chain contributions, instead of leaving
   the pairwise reduction to auto-sharding.
+
+The surrogate side of the same discipline lives in
+`dmosopt_tpu.models.gp_sharded`: the exact-GP hyperparameter fit as a
+tiled blocked Cholesky whose panel factor is replicated and whose
+rank-B trailing updates are local to each device's row slab of the
+kernel matrix — the second explicit-collective consumer of the mesh's
+population axis, opt-in via the exact-GP family's ``surrogate_mesh=``
+knob.
 """
 
 from __future__ import annotations

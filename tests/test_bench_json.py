@@ -1,0 +1,73 @@
+"""bench.py JSON writer: numpy/jax scalars must serialize (the
+BENCH_r03 crash was a device scalar reaching `json.dumps` and dying in
+dtype conversion against an unreachable backend)."""
+
+import json
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import bench
+
+
+def test_json_round_trips_numpy_and_jax_scalars():
+    result = {
+        "np_f32": np.float32(1.5),
+        "np_f64": np.float64(2.25),
+        "np_i64": np.int64(7),
+        "np_bool": np.bool_(True),
+        "np_arr": np.arange(3, dtype=np.float32),
+        "jax_scalar": jnp.float32(3.5),
+        "jax_arr": jnp.asarray([1.0, 2.0], jnp.float32),
+        "nested": {"v": np.float32(0.25), "l": [np.int32(1), jnp.int32(2)]},
+        "plain": {"s": "x", "f": 1.0, "i": 3, "none": None},
+    }
+    line = bench._dumps(result)
+    back = json.loads(line)
+    assert back["np_f32"] == 1.5
+    assert back["np_f64"] == 2.25
+    assert back["np_i64"] == 7
+    assert back["np_bool"] is True
+    assert back["np_arr"] == [0.0, 1.0, 2.0]
+    assert back["jax_scalar"] == 3.5
+    assert back["jax_arr"] == [1.0, 2.0]
+    assert back["nested"] == {"v": 0.25, "l": [1, 2]}
+    assert back["plain"] == result["plain"]
+
+
+def test_json_default_rejects_arbitrary_objects():
+    class Opaque:
+        pass
+
+    try:
+        bench._dumps({"bad": Opaque()})
+    except TypeError:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("expected TypeError for non-coercible object")
+
+
+def test_import_bench_stays_jax_free():
+    """`import bench` must not import jax (the orchestrator's
+    wedged-tunnel survival contract) — the sanitizer is duck-typed for
+    exactly this reason. Checked in a clean subprocess: this test
+    module itself imports jax, so an in-process check proves nothing."""
+    import os
+    import subprocess
+
+    repo = __file__.rsplit("/", 2)[0]
+    code = (
+        "import sys, bench\n"
+        "assert bench.jax is None\n"
+        "assert 'jax' not in sys.modules, 'import bench pulled in jax'\n"
+        "assert bench._json_default(type('D', (), {'item': lambda s: 42})()) == 42\n"
+        "print('OK')\n"
+    )
+    env = dict(os.environ, PYTHONPATH=repo)
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0 and "OK" in out.stdout, out.stderr[-1000:]

@@ -1,0 +1,428 @@
+"""Mesh-sharded GP fit tests (models/gp_sharded.py) on the forced
+8-device CPU mesh.
+
+Oracle pattern, mirroring the sharded rank sweep's: the tiled
+shard_map programs are pinned against the single-device dense path —
+`posterior_from_params` for the factorization at fixed hyperparameters
+(identical math, f32 reduction-order tolerance), jax autodiff of the
+dense NMLL for the analytic custom VJP, and `fit_gp_batch` for the full
+distributed Adam fit (same restart grid, trajectory-level tolerance).
+Routing is pinned by call counting so the single-device default can't
+silently change.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu.models import gp, gp_sharded
+from dmosopt_tpu.models.gp import GPR_Matern, gp_predict
+from dmosopt_tpu.models.predictor import build_whitened_cache
+from dmosopt_tpu.parallel.mesh import create_mesh
+from dmosopt_tpu.utils.prng import as_key
+
+needs_devices = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _data(P, dim=5, d=2, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(size=(P, dim)).astype(dtype)
+    Y = np.stack([np.sin(3.0 * X[:, 0]), X.sum(1)], 1)[:, :d]
+    Y = ((Y - Y.mean(0)) / Y.std(0)).astype(dtype)
+    return jnp.asarray(X), jnp.asarray(Y)
+
+
+# -------------------------------------------------- factorization parity
+
+
+@needs_devices
+@pytest.mark.parametrize(
+    "n_real,P,tile",
+    [
+        (64, 64, 16),   # exact bucket, tile < slab
+        (50, 64, 64),   # padded bucket, single panel
+        (96, 96, 32),   # panel width not aligned with the 12-row slabs
+    ],
+)
+def test_posterior_sharded_matches_oracle(n_real, P, tile):
+    """The tiled blocked Cholesky + column-sharded whitening solve must
+    reproduce the dense masked factorization at the same (fixed)
+    hyperparameters: L, W = L⁻¹, alpha, and the NMLL — including bucket
+    padding (identity-decoupled rows) and panels that straddle device
+    slab boundaries."""
+    mesh = create_mesh(8)
+    X, Y = _data(P)
+    tm = jnp.asarray((np.arange(P) < n_real).astype(np.float32))
+    Ym = Y * tm[:, None]
+    amp = jnp.asarray([1.3, 0.8], jnp.float32)
+    ls = jnp.asarray([[0.4], [0.7]], jnp.float32)
+    noise = jnp.asarray([1e-4, 3e-4], jnp.float32)
+
+    L, W, alpha, nmll = gp_sharded.posterior_sharded(
+        X, Ym, tm, amp, ls, noise, kernel="matern52", rel_jitter=1e-4,
+        mesh=mesh, shard_axis="pop", tile=tile,
+    )
+    L0, a0, n0 = gp.posterior_from_params(
+        X, Ym, tm, amp, ls, noise, kernel="matern52", rel_jitter=1e-4
+    )
+    np.testing.assert_allclose(np.asarray(L), np.asarray(L0), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(alpha), np.asarray(a0), atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(nmll), np.asarray(n0), rtol=1e-4, atol=1e-3
+    )
+    # the whitening factor the predictor adopts
+    fit0 = gp.GPFit(
+        X=X, L=L0, alpha=a0, amp=amp, ls=ls, noise=noise,
+        y_mean=jnp.zeros(2), y_std=jnp.ones(2), nmll=n0, train_mask=tm,
+    )
+    np.testing.assert_allclose(
+        np.asarray(W), np.asarray(build_whitened_cache(fit0)), atol=1e-3
+    )
+
+
+@needs_devices
+def test_nmll_gradient_matches_autodiff():
+    """The analytic custom VJP (½(K⁻¹ − ααᵀ) chained through the local
+    kernel rows) must match jax autodiff of the dense NMLL — value and
+    gradients w.r.t. amp, lengthscale, and noise — on both exact and
+    masked shapes."""
+    mesh = create_mesh(8)
+    P = 48
+    X, Y = _data(P, d=1, seed=3)
+    for n_real in (P, 40):
+        tm = jnp.asarray((np.arange(P) < n_real).astype(np.float32))
+        y = Y[:, 0] * tm
+
+        def ref(a, l, nz):
+            K = gp._apply_train_mask(
+                gp._regularized_kernel(
+                    X, l, a, nz, gp._KERNELS["matern52"], 1e-4
+                ),
+                tm,
+            )
+            Lc = jnp.linalg.cholesky(K)
+            al = jax.scipy.linalg.cho_solve((Lc, True), y)
+            return (
+                0.5 * jnp.dot(y, al)
+                + jnp.sum(jnp.log(jnp.diagonal(Lc)))
+                + 0.5 * jnp.sum(tm) * gp._LOG2PI
+            )
+
+        def sh(a, l, nz):
+            return gp_sharded.nmll_sharded(
+                a, l, nz, X, tm, y, mesh=mesh, tile=16, rel_jitter=1e-4
+            )
+
+        args = (
+            jnp.float32(1.3), jnp.asarray([0.45], jnp.float32),
+            jnp.float32(2e-4),
+        )
+        v0, g0 = jax.value_and_grad(ref, argnums=(0, 1, 2))(*args)
+        v1, g1 = jax.jit(jax.value_and_grad(sh, argnums=(0, 1, 2)))(*args)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+        for r, s in zip(g0, g1):
+            np.testing.assert_allclose(
+                np.asarray(s), np.asarray(r), rtol=2e-3, atol=1e-4
+            )
+
+
+# ------------------------------------------------------- full-fit parity
+
+
+@needs_devices
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "n_real,ard",
+    [
+        (64, False),   # exact bucket
+        (50, False),   # padded bucket (mask-decoupled rows)
+        (128, True),   # bigger exact bucket, ARD lengthscales
+    ],
+)
+def test_fit_gp_sharded_matches_single_device(n_real, ard):
+    """The full distributed Adam fit from the identical restart grid
+    must land where `fit_gp_batch` lands: hyperparameters, winning
+    restart, NMLL, and the resulting posterior (L/alpha via predict)
+    within trajectory tolerance — the gradients are mathematically
+    equal, so only f32 reduction order separates the paths."""
+    mesh = create_mesh(8)
+    dim = 5
+    rng = np.random.default_rng(7 + n_real)
+    Xr = rng.uniform(size=(n_real, dim))
+    Yr = np.stack([np.sin(3.0 * Xr[:, 0]), Xr.sum(1)], 1)
+    Yr = (Yr - Yr.mean(0)) / Yr.std(0)
+    Xp, Yp, tmask = gp._pad_to_bucket(
+        Xr.astype(np.float32), Yr.astype(np.float32)
+    )
+    X, Y = jnp.asarray(Xp), jnp.asarray(Yp)
+    tm = jnp.asarray(tmask)
+    common = dict(n_starts=4, n_iter=60, ard=ard)
+
+    ref = gp.fit_gp_batch(as_key(2), X, Y, train_mask=tm, **common)
+    sh = gp_sharded.fit_gp_sharded(
+        as_key(2), X, Y, train_mask=tm, mesh=mesh, tile=16, **common
+    )
+
+    np.testing.assert_array_equal(
+        np.asarray(sh.best_start), np.asarray(ref.best_start)
+    )
+    assert int(sh.n_steps) == int(ref.n_steps)
+    np.testing.assert_allclose(
+        np.asarray(sh.nmll), np.asarray(ref.nmll), rtol=5e-3, atol=5e-3
+    )
+    # lengthscales shape the posterior mean — pinned tightly; amplitude
+    # sits on the amp/noise ridge the NMLL barely sees (the same
+    # non-identifiability refit.py's stability metric accounts for), so
+    # two equal-NMLL trajectories may separate along it — pinned loosely
+    np.testing.assert_allclose(
+        np.log(np.asarray(sh.ls)), np.log(np.asarray(ref.ls)), atol=0.15
+    )
+    np.testing.assert_allclose(
+        np.log(np.asarray(sh.amp)), np.log(np.asarray(ref.amp)), atol=0.3
+    )
+    # L and alpha at the (close) fitted hyperparameters, via predictions:
+    # the mean is the functional gate; variance inherits the amp ridge
+    Xq = jnp.asarray(rng.uniform(size=(32, dim)).astype(np.float32))
+    mu0, v0 = gp_predict(ref, Xq)
+    mu1, v1 = gp_predict(sh, Xq)
+    np.testing.assert_allclose(
+        np.asarray(mu1), np.asarray(mu0), atol=2e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(v1), np.asarray(v0), rtol=0.35, atol=1e-4
+    )
+
+
+# ---------------------------------------------------------------- routing
+
+
+def _count_calls(monkeypatch):
+    """Wrap both fit entry points with call counters (the trace-time
+    pin: routing happens eagerly in the constructor, so Python-level
+    call counts ARE the routing decision)."""
+    counts = {"batch": 0, "sharded": 0}
+    orig_batch = gp.fit_gp_batch
+    orig_sharded = gp_sharded.fit_gp_sharded
+
+    def batch(*a, **k):
+        counts["batch"] += 1
+        return orig_batch(*a, **k)
+
+    def sharded(*a, **k):
+        counts["sharded"] += 1
+        return orig_sharded(*a, **k)
+
+    monkeypatch.setattr(gp, "fit_gp_batch", batch)
+    monkeypatch.setattr(gp_sharded, "fit_gp_sharded", sharded)
+    return counts
+
+
+@needs_devices
+def test_routing_counts_pin_single_device_default(monkeypatch):
+    """The single-device default can't silently change: without
+    ``surrogate_mesh`` (or below its threshold, or without a mesh) the
+    constructor must call `fit_gp_batch` exactly once and the sharded
+    fit never; with the opt-in satisfied, the reverse."""
+    mesh = create_mesh(8)
+    rng = np.random.default_rng(0)
+    dim = 4
+    xin = rng.uniform(size=(48, dim))
+    yin = np.stack([xin[:, 0], xin.sum(1)], 1)
+    args = (xin, yin, dim, 2, np.zeros(dim), np.ones(dim))
+    fast = dict(seed=0, n_starts=2, n_iter=10)
+
+    # default: no surrogate_mesh knob at all
+    counts = _count_calls(monkeypatch)
+    GPR_Matern(*args, mesh=mesh, **fast)
+    assert counts == {"batch": 1, "sharded": 0}
+
+    # opted in but below the archive-size threshold
+    counts = _count_calls(monkeypatch)
+    GPR_Matern(
+        *args, mesh=mesh, surrogate_mesh={"min_points": 10_000}, **fast
+    )
+    assert counts == {"batch": 1, "sharded": 0}
+
+    # opted in but no mesh to shard over
+    counts = _count_calls(monkeypatch)
+    GPR_Matern(*args, surrogate_mesh={"min_points": 0}, **fast)
+    assert counts == {"batch": 1, "sharded": 0}
+
+    # fully opted in: the sharded path serves, the dense fit never runs.
+    # Default predictor is "solve" — the unused W = L⁻¹ factor must be
+    # dropped (holding it would double resident fit memory for nothing)
+    counts = _count_calls(monkeypatch)
+    sm = GPR_Matern(
+        *args, mesh=mesh,
+        surrogate_mesh={"min_points": 0, "tile": 16}, **fast,
+    )
+    assert counts == {"batch": 0, "sharded": 1}
+    assert sm.fit_info.get("sharded") is True
+    assert sm.fit_info.get("shard_devices") == 8
+    assert sm.fit.whitened is None
+
+    # a matmul predictor keeps the factor (it serves predict)
+    counts = _count_calls(monkeypatch)
+    sm = GPR_Matern(
+        *args, mesh=mesh, predictor="matmul",
+        surrogate_mesh={"min_points": 0, "tile": 16}, **fast,
+    )
+    assert counts == {"batch": 0, "sharded": 1}
+    assert sm.fit.whitened is not None
+
+    # a tile that does not divide the padding bucket degrades to the
+    # default tile instead of crashing mid-run (the fallback discipline)
+    counts = _count_calls(monkeypatch)
+    sm = GPR_Matern(
+        *args, mesh=mesh,
+        surrogate_mesh={"min_points": 0, "tile": 100}, **fast,
+    )
+    assert counts == {"batch": 0, "sharded": 1}
+    assert sm.fit_info.get("shard_tile") == gp_sharded.default_chol_tile(
+        sm.fit.X.shape[0]
+    )
+
+
+@needs_devices
+def test_routing_falls_back_on_nonfinite_probe(monkeypatch):
+    """The post-fit finite probe: a sharded fit returning a non-finite
+    NMLL is discarded and the single-device fit serves instead — the
+    routed path may fail, it must never be served failed."""
+    mesh = create_mesh(8)
+    rng = np.random.default_rng(1)
+    dim = 4
+    xin = rng.uniform(size=(48, dim))
+    yin = np.stack([xin[:, 0], xin.sum(1)], 1)
+    counts = _count_calls(monkeypatch)
+    orig = gp_sharded.fit_gp_sharded
+
+    def poisoned(*a, **k):
+        counts["sharded"] += 1
+        fit = orig(*a, **k)
+        return fit._replace(nmll=jnp.full_like(fit.nmll, jnp.inf))
+
+    monkeypatch.setattr(gp_sharded, "fit_gp_sharded", poisoned)
+    sm = GPR_Matern(
+        xin, yin, dim, 2, np.zeros(dim), np.ones(dim),
+        mesh=mesh, surrogate_mesh={"min_points": 0, "tile": 16},
+        seed=0, n_starts=2, n_iter=10,
+    )
+    assert counts["batch"] == 1  # fell back
+    assert "sharded" not in sm.fit_info
+    assert np.all(np.isfinite(np.asarray(sm.fit.nmll)))
+
+
+def test_surrogate_mesh_spec_validation():
+    assert gp._resolve_surrogate_mesh_spec(None) is None
+    assert gp._resolve_surrogate_mesh_spec(False) is None
+    spec = gp._resolve_surrogate_mesh_spec(True)
+    assert spec["min_points"] == 4096 and spec["tile"] is None
+    spec = gp._resolve_surrogate_mesh_spec({"min_points": 16, "tile": 32})
+    assert spec["min_points"] == 16 and spec["tile"] == 32
+    with pytest.raises(ValueError):
+        gp._resolve_surrogate_mesh_spec({"bogus_knob": 1})
+    with pytest.raises(TypeError):
+        gp._resolve_surrogate_mesh_spec("yes")
+
+
+def test_default_chol_tile_divides():
+    for P in (64, 96, 128, 320, 512, 768, 4096, 32768):
+        B = gp_sharded.default_chol_tile(P)
+        assert P % B == 0 and B <= 512
+
+
+# -------------------------------------------------- predictor composition
+
+
+@needs_devices
+def test_matmul_predictor_adopts_fit_whitened():
+    """A routed sharded fit carries W = L⁻¹; the matmul predictor must
+    adopt it (no O(N³) rebuild) and serve the same answers as a
+    predictor that built its own cache from the same posterior."""
+    mesh = create_mesh(8)
+    rng = np.random.default_rng(4)
+    dim = 4
+    xin = rng.uniform(size=(56, dim))
+    yin = np.stack([np.sin(2 * xin[:, 0]), xin.sum(1)], 1)
+    sm = GPR_Matern(
+        xin, yin, dim, 2, np.zeros(dim), np.ones(dim),
+        mesh=mesh, surrogate_mesh={"min_points": 0, "tile": 16},
+        seed=0, n_starts=2, n_iter=20, predictor="matmul",
+    )
+    pred = sm.build_predictor()
+    assert pred.regime == "matmul"
+    assert pred.whitened is sm.fit.whitened  # adopted, not rebuilt
+    np.testing.assert_allclose(
+        np.asarray(pred.whitened),
+        np.asarray(build_whitened_cache(sm.fit)),
+        atol=2e-4,
+    )
+    Xq = jnp.asarray(rng.uniform(size=(16, dim)).astype(np.float32))
+    mu, var = pred.predict_normalized(Xq)
+    mu0, var0 = gp_predict(sm.fit, Xq)
+    np.testing.assert_allclose(np.asarray(mu), np.asarray(mu0), atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(var), np.asarray(var0), rtol=2e-2, atol=1e-5
+    )
+
+
+@needs_devices
+def test_nystrom_predictor_releases_fit_whitened():
+    """With predictor="nystrom" the fit-carried W = L⁻¹ exists only as
+    the probe-failure matmul fallback; once the distillation probe
+    passes, the (d, P, P) factor must be released rather than held
+    resident all epoch."""
+    mesh = create_mesh(8)
+    rng = np.random.default_rng(6)
+    dim = 4
+    xin = rng.uniform(size=(56, dim))
+    yin = np.stack([np.sin(2 * xin[:, 0]), xin.sum(1)], 1)
+    sm = GPR_Matern(
+        xin, yin, dim, 2, np.zeros(dim), np.ones(dim),
+        mesh=mesh, surrogate_mesh={"min_points": 0, "tile": 16},
+        seed=0, n_starts=2, n_iter=20, predictor="nystrom",
+    )
+    assert sm.fit.whitened is not None  # held for the fallback...
+    pred = sm.build_predictor()
+    if pred.regime == "nystrom":  # ...released once the probe passes
+        assert sm.fit.whitened is None
+    else:  # probe-failure fallback adopted it instead
+        assert pred.whitened is not None
+
+
+def test_rank_update_drops_stale_whitened():
+    """A rank-k posterior update changes L, so a fit-carried whitening
+    factor would be stale — the refit controller must drop it (the
+    predictor layer rebuilds or extends its own cache)."""
+    from dmosopt_tpu.models.refit import (
+        SurrogateRefitConfig,
+        SurrogateRefitController,
+    )
+    from dmosopt_tpu import moasmo
+
+    rng = np.random.default_rng(2)
+    dim = 4
+    X = rng.uniform(size=(80, dim))
+    Y = np.column_stack([X.sum(1), ((X - 0.5) ** 2).sum(1)])
+    # rank_update_after=0: rank-eligible right after the first fit
+    ctrl = SurrogateRefitController(
+        SurrogateRefitConfig("warm", rank_update_after=0)
+    )
+    kwargs = {"n_starts": 2, "n_iter": 40, "seed": 0}
+
+    def train(n):
+        return moasmo.train(
+            dim, 2, np.zeros(dim), np.ones(dim), X[:n], Y[:n], None,
+            surrogate_method_kwargs=dict(kwargs), surrogate_refit=ctrl,
+        )
+
+    sm = train(56)
+    # simulate a sharded fit's factor riding the cached posterior
+    sm.fit = sm.fit._replace(whitened=build_whitened_cache(sm.fit))
+    sm2 = train(60)  # append inside the bucket -> rank path
+    assert ctrl.path_history[-1] == "rank"
+    assert sm2.fit_info.get("refit_path") == "rank"
+    assert sm2.fit.whitened is None
