@@ -14,8 +14,17 @@ test-slow:
 test-all:
 	$(PY) -m pytest tests/ -q -m ""
 
+# graftlint: the JAX-aware static-analysis suite (hot-path purity,
+# frozen-path guard, dtype discipline, retrace hazards, metric catalog)
+# over the package + the jax-free entry points. Pure-ast — runs even
+# when the TPU tunnel is down; also enforced inside the fast suite
+# (tests/test_graftlint.py). Rule catalog: docs/static-analysis.md.
+lint:
+	$(PY) -m tools.graftlint
+
 # every metric name emitted in the package must be cataloged in
-# docs/observability.md (also enforced inside the fast suite)
+# docs/observability.md (also enforced inside the fast suite); now an
+# alias over graftlint's metrics-catalog rule
 lint-metrics:
 	$(PY) tools/lint_metrics.py
 

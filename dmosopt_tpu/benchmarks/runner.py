@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from dmosopt_tpu import driver
+from dmosopt_tpu.utils import json_default
 from dmosopt_tpu.benchmarks.moo_benchmarks import (
     generate_problem_space,
     get_problem,
@@ -191,9 +192,10 @@ class BenchmarkRunner:
             self.output_dir
             / f"{result.problem_name}_m{result.n_objectives}_result.json"
         )
-        path.write_text(json.dumps(asdict(result), indent=2))
+        path.write_text(json.dumps(asdict(result), indent=2, default=json_default))
 
     def save_summary(self, filename: str = "summary.json"):
         (self.output_dir / filename).write_text(
-            json.dumps([asdict(r) for r in self.results], indent=2)
+            json.dumps([asdict(r) for r in self.results], indent=2,
+                       default=json_default)
         )

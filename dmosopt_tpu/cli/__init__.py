@@ -21,6 +21,7 @@ import click
 import numpy as np
 
 from dmosopt_tpu import moasmo
+from dmosopt_tpu.utils import json_default
 from dmosopt_tpu.storage import h5_load_raw
 
 
@@ -189,7 +190,7 @@ def analyze(file_path, opt_id, constraints, knn, sort_key, filter_objectives,
 
     if output_file is not None:
         with open(output_file, "w") as fh:
-            json.dump(out, fh, indent=2)
+            json.dump(out, fh, indent=2, default=json_default)
         click.echo(f"wrote {output_file}")
 
 
@@ -389,7 +390,7 @@ def telemetry(file_path, opt_id, problem_id, with_hv, output_file):
             for e in sorted(summaries)
         }
         with open(output_file, "w") as fh:
-            json.dump(payload, fh, indent=2)
+            json.dump(payload, fh, indent=2, default=json_default)
         click.echo(f"wrote {output_file}")
 
 

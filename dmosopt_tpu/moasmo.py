@@ -212,7 +212,7 @@ def _optimize_on_device(
         return state, (x_gen, y_gen)
 
     @jax.jit
-    def run_chunk(state, keys):
+    def run_chunk(state, keys):  # graftlint: disable=retrace-hazard -- built once per optimize() call, reused for every generation chunk; `step` closes over this call's optimizer/eval_fn by design
         return jax.lax.scan(step, state, keys)
 
     adaptive = getattr(optimizer, "adaptive_population_size", False)

@@ -163,7 +163,7 @@ def fit_deep_gp(
     opt = optax.adam(learning_rate)
 
     @jax.jit
-    def train_chunk(params, opt_state, keys):
+    def train_chunk(params, opt_state, keys):  # graftlint: disable=retrace-hazard -- one closure per fit_deep_gp call, amortized over n_iter steps; captures are the fit's static config
         def step(carry, k):
             params, opt_state = carry
             if B < N:
@@ -215,7 +215,7 @@ def fit_deep_gp(
 
     # posterior cache on the full training set
     @jax.jit
-    def posterior(params):
+    def posterior(params):  # graftlint: disable=retrace-hazard -- traced once per fit on the full training set; caching the posterior program beyond the fit would pin X/Y buffers
         F = _mlp_forward(params.mlp, X)
         amp = b_amp.forward(params.u_amp)
         ls = b_ls.forward(params.u_ls)

@@ -201,7 +201,7 @@ def fit_svgp(
     loss_fn = lambda p, Xb, Yb: -_elbo(p, b_amp, b_ls, b_noise, Xb, Yb, N, kernel_fn)
 
     @jax.jit
-    def train(params, opt_state, key):
+    def train(params, opt_state, key):  # graftlint: disable=retrace-hazard -- one closure per fit_svgp call, amortized over n_iter minibatch steps
         def step(carry, k):
             params, opt_state = carry
             sel = jax.random.choice(k, N, (B,), replace=False)

@@ -93,7 +93,7 @@ def tournament_selection(
     replace=False)`` in the reference.
     """
     n = rank.shape[0]
-    keys = [jnp.asarray(rank, jnp.float64 if rank.dtype == jnp.float64 else jnp.float32)]
+    keys = [jnp.asarray(rank, jnp.float64 if rank.dtype == jnp.float64 else jnp.float32)]  # graftlint: disable=dtype-discipline -- deliberate x64 passthrough: under the GPR dtype=float64 opt-in (gp._resolve_dtype enables global x64) f64 sort keys must not be demoted; without x64 the branch is statically f32
     for m in tiebreak_metrics:
         keys.append(jnp.asarray(m))
     # lexsort: last key most significant; reference passes (rank, *metrics)

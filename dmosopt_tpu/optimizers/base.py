@@ -248,7 +248,7 @@ def run_ea_loop(
     if cached is None or cached[0] is not eval_fn:
 
         @jax.jit
-        def run(bounds, state, keys):
+        def run(bounds, state, keys):  # graftlint: disable=retrace-hazard -- cached on the optimizer keyed by eval_fn (see comment above); bounds are traced args so the closure carries no per-call state
             body = lambda s, k: step_with_bounds(bounds, s, k)
             return jax.lax.scan(body, state, keys)[0]
 

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from dmosopt_tpu.utils import json_default
 from dmosopt_tpu.datatypes import (
     EvalEntry,
     ParameterDefn,
@@ -125,7 +126,7 @@ def feature_columns(f) -> np.ndarray:
 
 def _space_to_json(space: Optional[ParameterSpace]) -> str:
     if space is None:
-        return json.dumps(None)
+        return json.dumps(None, default=json_default)
 
     items = []
     for leaf in space.items:
@@ -146,7 +147,9 @@ def _space_to_json(space: Optional[ParameterSpace]) -> str:
                     "is_integer": bool(leaf.is_integer),
                 }
             )
-    return json.dumps(items)
+    # bounds arrive as user-supplied space dicts: np.float64 scalars are
+    # common and crash the default encoder (the BENCH_r03 class)
+    return json.dumps(items, default=json_default)
 
 
 def _space_from_json(s: str, is_value_only: bool = False) -> Optional[ParameterSpace]:
@@ -167,7 +170,7 @@ def _space_from_json(s: str, is_value_only: bool = False) -> Optional[ParameterS
 
 
 def _json_attr(grp, name, value):
-    grp.attrs[name] = json.dumps(value)
+    grp.attrs[name] = json.dumps(value, default=json_default)
 
 
 def _load_json_attr(grp, name, default=None):

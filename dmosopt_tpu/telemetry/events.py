@@ -28,6 +28,8 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
+from dmosopt_tpu.utils import json_default
+
 
 def jsonable(value):
     """Coerce numpy scalars/arrays and other common non-JSON types to
@@ -98,7 +100,12 @@ class EventLog:
         with self._lock:
             self._ring.append(ev)
             if self._fh is not None:
-                self._fh.write(json.dumps(ev.to_dict()) + "\n")
+                # fields are jsonable()-coerced above, but jax device
+                # arrays (not np.ndarray) fall through it unchanged —
+                # the duck-typed default catches those (BENCH_r03 class)
+                self._fh.write(
+                    json.dumps(ev.to_dict(), default=json_default) + "\n"
+                )
         return ev
 
     def records(

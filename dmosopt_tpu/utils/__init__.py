@@ -1,0 +1,23 @@
+"""Shared utilities. Import-light by design: nothing here may import
+jax at module scope (the CLI and storage layers must load on hosts
+where the TPU tunnel is down)."""
+
+
+def json_default(o):
+    """``json.dumps(..., default=json_default)`` fallback coercing
+    numpy/jax scalars and arrays to plain Python values — the BENCH_r03
+    crash class: a stray ``np.float64`` (or device scalar) in a payload
+    raises TypeError from the default encoder. Duck-typed on
+    ``.tolist()`` / ``.item()`` so no numpy/jax import is needed (same
+    contract as ``bench._json_default``, which must additionally stay
+    importable from the jax-free bench orchestrator)."""
+    for attr in ("tolist", "item"):
+        fn = getattr(o, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:
+                continue
+    raise TypeError(
+        f"Object of type {type(o).__name__} is not JSON serializable"
+    )

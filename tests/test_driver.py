@@ -75,8 +75,15 @@ def test_run_jax_objective_batch():
         f2 = g * (1.0 - jnp.sqrt(f1 / g))
         return jnp.stack([f1, f2], axis=1)
 
+    # n_epochs=3, not 2: at 2 epochs this seeded run sits exactly on a
+    # quality cliff — host-class-dependent XLA fusion (an ulp in the GP
+    # fit) decides whether the front lands 3 points at d~0.3 or 26+
+    # points under 0.1, which made the oracle fail on some hosts since
+    # the seed. One more epoch moves it far from the cliff on every
+    # host class measured (31 points < 0.1 vs the >= 5 < 0.2 oracle)
+    # while still catching real jax-objective-path regressions.
     params = _base_params(
-        obj_fun=zdt1_batch, jax_objective=True, n_epochs=2,
+        obj_fun=zdt1_batch, jax_objective=True, n_epochs=3,
     )
     best = dmosopt_tpu.run(params, verbose=False)
     prms, lres = best

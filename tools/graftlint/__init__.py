@@ -1,0 +1,20 @@
+"""graftlint — a JAX-aware static-analysis suite for this repository.
+
+Pure-``ast`` (never imports jax or the package under analysis, so it runs
+even when the TPU tunnel is down). The engine parses the target modules,
+resolves import aliases, builds a call graph, and marks every function
+whose body is traced — reachable from a ``jax.jit`` / ``lax.scan`` /
+``lax.while_loop`` / ``shard_map`` region — so rules can distinguish the
+device hot path from eager host code. Rule catalog, suppression syntax
+and the frozen-path registry procedure: docs/static-analysis.md.
+"""
+
+from tools.graftlint.engine import (  # noqa: F401
+    Finding,
+    LintContext,
+    load_context,
+    run_lint,
+)
+from tools.graftlint.registry import REGISTRY, all_rules, get_rule  # noqa: F401
+
+__version__ = "1.0"

@@ -1,0 +1,130 @@
+"""graftlint CLI.
+
+    python -m tools.graftlint [paths...] [options]
+
+Default targets: ``dmosopt_tpu/``, ``bench.py``, ``__graft_entry__.py``
+(relative to the repo root — the ``make lint`` surface). Jax-free by
+construction: runs even when the TPU tunnel is down.
+
+Options:
+    --json            machine-readable output (findings + summary)
+    --select R1,R2    run only these rules
+    --list-rules      print the rule catalog and exit
+    --hot             print every jit-region function with provenance
+    --frozen-hashes   print current normalized hashes of all registered
+                      frozen functions (copy-paste for registry bumps)
+
+Exit status: 0 when no unsuppressed findings, 1 otherwise, 2 on usage
+errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+if str(REPO_ROOT) not in sys.path:  # `python tools/graftlint` direct runs
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.graftlint.engine import (  # noqa: E402
+    DEFAULT_TARGETS,
+    frozen_hash,
+    load_context,
+    run_lint,
+)
+from tools.graftlint.registry import all_rules  # noqa: E402
+
+
+def _print_rules() -> int:
+    for rule in all_rules(None):
+        print(f"{rule.name}")
+        print(f"    {rule.description}")
+        print(f"    incident: {rule.incident}")
+    return 0
+
+
+def _print_hot(targets) -> int:
+    ctx = load_context(REPO_ROOT, targets)
+    for info in sorted(ctx.hot_functions(), key=lambda f: f.full_name):
+        kind = (
+            "jit entry" if info.jit_entry
+            else "traced body" if info.traced_body
+            else info.hot_via
+        )
+        print(f"{info.full_name}  ({kind})  {info.module.relpath}:{info.line}")
+    print(f"{len(ctx.hot_functions())} jit-region function(s)")
+    return 0
+
+
+def _print_frozen_hashes(targets) -> int:
+    from tools.graftlint.frozen_registry import FROZEN
+
+    ctx = load_context(REPO_ROOT, targets)
+    for name in sorted(FROZEN):
+        info = ctx.functions.get(name)
+        if info is None:
+            print(f"{name}: NOT FOUND in lint targets")
+        else:
+            print(f'"{name}": "{frozen_hash(info.node)}"')
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", add_help=True)
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--select", default=None)
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--hot", action="store_true")
+    ap.add_argument("--frozen-hashes", action="store_true")
+    args = ap.parse_args(argv)
+
+    targets = args.paths or list(DEFAULT_TARGETS)
+    rules = None
+    if args.select:
+        rules = [r.strip() for r in args.select.split(",") if r.strip()]
+    try:
+        if args.list_rules:
+            return _print_rules()
+        if args.hot:
+            return _print_hot(targets)
+        if args.frozen_hashes:
+            return _print_frozen_hashes(targets)
+        findings = run_lint(REPO_ROOT, targets, rules=rules)
+    except (KeyError, ValueError) as e:
+        print(f"graftlint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    live = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in live],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "counts": {
+                "findings": len(live),
+                "suppressed": len(suppressed),
+            },
+        }, indent=2))
+        return 1 if live else 0
+
+    for f in live:
+        print(f.format())
+    if live:
+        by_rule: dict = {}
+        for f in live:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        summary = ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items()))
+        print(f"graftlint: {len(live)} finding(s) ({summary}); "
+              f"{len(suppressed)} suppressed")
+        return 1
+    print(f"graftlint: OK — 0 findings ({len(suppressed)} suppressed with "
+          f"justification)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,855 @@
+"""graftlint engine: module loading, alias resolution, the jit-region
+resolver, per-line suppressions, and the finding pipeline.
+
+Everything here is pure ``ast`` + stdlib — importing this module must
+never import jax (or the package under analysis): ``make lint`` has to
+run on a host where the TPU tunnel is down and ``import jax`` hangs.
+
+The jit-region resolver is the piece the rules lean on. A function's
+body is a *jit region* (``FunctionInfo.hot``) when tracing reaches it:
+
+- it is decorated with / wrapped by ``jax.jit`` (incl.
+  ``partial(jax.jit, ...)`` and the ``fn = jax.jit(fn)`` call form) or
+  ``jax.custom_vjp`` / ``jax.custom_jvp``;
+- it is passed as the traced-callable argument of a control-flow or
+  mapping combinator (``lax.scan`` / ``while_loop`` / ``fori_loop`` /
+  ``cond`` / ``switch`` / ``map`` / ``associative_scan``, ``shard_map``,
+  ``jax.vmap`` / ``grad`` / ``value_and_grad`` / ``checkpoint``) or to
+  ``<custom_vjp_fn>.defvjp``;
+- it is defined inside a jit region (nested ``def``); or
+- it is called from — or referenced as a callable inside — a jit
+  region, transitively (the call-graph walk).
+
+The resolver is deliberately an over-approximation: a function that is
+*sometimes* called eagerly but also reachable from a traced body is
+hot, because the traced call is the one that breaks. Deliberate
+exceptions (e.g. tracer-guarded eager-only telemetry) carry a
+``# graftlint: disable=<rule> -- why`` suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+import dataclasses
+import hashlib
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# ----------------------------------------------------------- constants
+
+#: decorators / wrappers whose argument becomes a compiled entry point
+JIT_WRAPPERS = {"jax.jit", "jax.pmap"}
+
+#: decorators that make the function body traced (fwd/bwd registered
+#: separately via ``.defvjp`` / ``.defjvp``)
+CUSTOM_DERIV = {"jax.custom_vjp", "jax.custom_jvp"}
+
+#: canonical combinator name -> positional indices of traced callables
+TRACED_CALLABLE_ARGS: Dict[str, Tuple[int, ...]] = {
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.vmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+    "jax.shard_map": (0,),
+}
+
+#: ``lax.switch(index, branches...)``: every arg after the index
+SWITCH_LIKE = {"jax.lax.switch"}
+
+#: method names that register traced fwd/bwd rules on a custom_vjp fn
+DERIV_REGISTER_METHODS = {"defvjp", "defjvp"}
+
+_DIRECTIVE_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,-]+)"
+    r"(?:\s+--\s*(\S.*?))?\s*$"
+)
+
+
+# ------------------------------------------------------------ findings
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding, anchored to a source line."""
+
+    rule: str
+    path: str  # repo-relative
+    line: int
+    col: int
+    message: str
+    qualname: str = ""  # enclosing function, dotted, when known
+    suppressed: bool = False
+    justification: Optional[str] = None
+
+    def format(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        ctx = f" [{self.qualname}]" if self.qualname else ""
+        return f"{where}: {self.rule}: {self.message}{ctx}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    """A parsed ``# graftlint: disable=rule[,rule] -- justification``."""
+
+    line: int
+    rules: Tuple[str, ...]
+    justification: Optional[str]
+    # rule names that actually matched a finding — tracked per rule so
+    # a stale name in a comma list is still reported as unused
+    used: Set[str] = dataclasses.field(default_factory=set)
+
+
+# ------------------------------------------------------------- modules
+
+
+class Module:
+    """One parsed source file plus its alias map and suppressions."""
+
+    def __init__(self, path: Path, relpath: str, modname: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.modname = modname
+        self.source = source
+        self.is_package = Path(relpath).name == "__init__.py"
+        self.tree = ast.parse(source, filename=str(path))
+        self.aliases = _collect_aliases(self.tree, modname, self.is_package)
+        self.global_names = _collect_module_globals(self.tree)
+        self.suppressions: Dict[int, Suppression] = _collect_suppressions(source)
+        self.functions: Dict[str, "FunctionInfo"] = {}
+        self.lambda_infos: Dict[int, "FunctionInfo"] = {}  # id(node) -> info
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted canonical name of a Name/Attribute chain, following
+        the module's import aliases (``jnp.sum`` -> ``jax.numpy.sum``,
+        ``scan`` -> ``jax.lax.scan`` after ``from jax.lax import scan``).
+        None for anything that isn't a plain dotted chain."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(cur.id)
+        parts.reverse()
+        target = self.aliases.get(parts[0])
+        if target is not None:
+            return ".".join([target] + parts[1:])
+        return ".".join(parts)
+
+
+@dataclasses.dataclass(eq=False)  # identity hash: used in work-set walks
+class FunctionInfo:
+    """A function (or method) definition discovered in a module."""
+
+    module: Module
+    qualname: str  # dotted within the module, e.g. "Class.method"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    parent: Optional["FunctionInfo"]  # enclosing function, if nested
+    class_name: Optional[str]  # immediately enclosing class, if a method
+    jit_entry: bool = False  # jit/pmap/custom_vjp-wrapped
+    traced_body: bool = False  # passed to a tracing combinator
+    calls: Set[str] = dataclasses.field(default_factory=set)
+    refs: Set[str] = dataclasses.field(default_factory=set)
+    hot: bool = False
+    hot_via: str = ""  # provenance, for messages and --hot output
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.module.modname}.{self.qualname}"
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+def _collect_aliases(
+    tree: ast.Module, modname: str, is_package: bool = False
+) -> Dict[str, str]:
+    """Local name -> dotted canonical target, from every import
+    statement at any scope (lazy in-function imports included)."""
+    aliases: Dict[str, str] = {}
+    pkg_parts = modname.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    aliases[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative: resolve against this module's package
+                # for a package __init__, modname IS the containing
+                # package, so level=1 strips nothing; for a plain
+                # module it strips the module's own name first
+                strip = node.level - 1 if is_package else node.level
+                base_parts = pkg_parts[: max(0, len(pkg_parts) - strip)]
+                base = ".".join(base_parts + ([node.module] if node.module else []))
+            else:
+                base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                aliases[local] = f"{base}.{a.name}" if base else a.name
+    return aliases
+
+
+def _collect_suppressions(source: str) -> Dict[int, Suppression]:
+    """Directives are read from real COMMENT tokens only — a
+    directive-shaped string inside a docstring or string literal (e.g.
+    documentation of the syntax itself) is neither a suppression nor an
+    unused-suppression hygiene finding."""
+    import io
+    import tokenize
+
+    out: Dict[int, Suppression] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DIRECTIVE_RE.search(tok.string)
+            if m:
+                i = tok.start[0]
+                rules = tuple(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                out[i] = Suppression(
+                    line=i, rules=rules, justification=m.group(2)
+                )
+    except tokenize.TokenError:  # ast.parse succeeded; be permissive
+        pass
+    return out
+
+
+# --------------------------------------------------- function discovery
+
+
+class _FunctionCollector(ast.NodeVisitor):
+    """Walk a module recording every function def with its nesting."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self._func_stack: List[FunctionInfo] = []
+        self._class_stack: List[str] = []
+        self.classes: Dict[str, List[str]] = {}  # full name -> base names
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        full = f"{self.module.modname}.{node.name}"
+        self.classes[full] = [
+            b for b in (self.module.resolve(base) for base in node.bases)
+            if b is not None
+        ]
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node):
+        prefix = (
+            f"{self._func_stack[-1].qualname}." if self._func_stack
+            else (f"{self._class_stack[-1]}." if self._class_stack else "")
+        )
+        info = FunctionInfo(
+            module=self.module,
+            qualname=f"{prefix}{node.name}",
+            node=node,
+            parent=self._func_stack[-1] if self._func_stack else None,
+            class_name=self._class_stack[-1] if self._class_stack else None,
+        )
+        info.jit_entry = any(
+            _is_jit_expr(self.module, d) for d in node.decorator_list
+        ) or any(
+            self.module.resolve(d) in CUSTOM_DERIV
+            or (
+                isinstance(d, ast.Call)
+                and self.module.resolve(d.func) in CUSTOM_DERIV
+            )
+            for d in node.decorator_list
+        )
+        self.module.functions[info.qualname] = info
+        self._func_stack.append(info)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node: ast.Lambda):
+        """Lambdas are scopes of their own: an inline lambda handed to
+        ``lax.cond``/``lax.map``/``jit`` is a traced body whose contents
+        the hot-path rules must scan."""
+        prefix = (
+            f"{self._func_stack[-1].qualname}." if self._func_stack
+            else (f"{self._class_stack[-1]}." if self._class_stack else "")
+        )
+        info = FunctionInfo(
+            module=self.module,
+            qualname=f"{prefix}<lambda:{node.lineno}:{node.col_offset}>",
+            node=node,
+            parent=self._func_stack[-1] if self._func_stack else None,
+            class_name=self._class_stack[-1] if self._class_stack else None,
+        )
+        self.module.functions[info.qualname] = info
+        self.module.lambda_infos[id(node)] = info
+        self._func_stack.append(info)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+
+def _is_jit_expr(module: Module, node: ast.AST) -> bool:
+    """Does this decorator/callee expression resolve to a jit wrapper?
+    Handles ``jax.jit``, ``partial(jax.jit, ...)`` and
+    ``jax.jit(static_argnames=...)``-style factory calls."""
+    r = module.resolve(node)
+    if r in JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fr = module.resolve(node.func)
+        if fr in JIT_WRAPPERS:
+            return True
+        if fr in ("functools.partial", "partial"):
+            return bool(node.args) and _is_jit_expr(module, node.args[0])
+    return False
+
+
+# ------------------------------------------------------------- context
+
+
+class LintContext:
+    """Parsed modules + global function table + jit-region marks.
+
+    Rules receive one of these; ``emit`` applies line suppressions so a
+    rule never has to know about directives.
+    """
+
+    def __init__(self, repo_root: Path):
+        self.repo_root = Path(repo_root).resolve()
+        self.modules: List[Module] = []
+        self.modules_by_name: Dict[str, Module] = {}
+        self.functions: Dict[str, FunctionInfo] = {}  # full dotted name
+        self.classes: Dict[str, List[str]] = {}  # full name -> base names
+        self.class_relatives: Dict[str, Set[str]] = {}
+        self.parse_errors: List[Finding] = []
+        self.options: Dict[str, object] = {}  # per-run rule overrides
+
+    # ------------------------------------------------------- building
+
+    def add_file(self, path: Path):
+        path = Path(path)
+        rel = path.relative_to(self.repo_root).as_posix()
+        modname = _modname_from_relpath(rel)
+        try:
+            source = path.read_text()
+            mod = Module(path, rel, modname, source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            self.parse_errors.append(
+                Finding("parse-error", rel, line, 0, f"cannot parse: {e}")
+            )
+            return
+        collector = _FunctionCollector(mod)
+        collector.visit(mod.tree)
+        self.modules.append(mod)
+        self.modules_by_name[mod.modname] = mod
+        self.classes.update(collector.classes)
+        for info in mod.functions.values():
+            self.functions[info.full_name] = info
+
+    def finalize(self):
+        """Resolve the call graph and propagate jit-region marks."""
+        self._build_class_relatives()
+        for mod in self.modules:
+            for info in mod.functions.values():
+                _collect_edges(self, info)
+            # module-level statements register entries too (the
+            # ``jitted = jax.jit(fn)`` / ``op.defvjp(fwd, bwd)`` forms);
+            # the synthetic scope itself is eager import-time code, so
+            # its call/ref edges are discarded — only the marks stick
+            _collect_edges(self, module_scope(mod))
+        self._propagate_hot()
+
+    def resolve_symbol(self, dotted: Optional[str], index: Dict[str, object]) -> Optional[str]:
+        """Chase package re-exports: ``dmosopt_tpu.ops.non_dominated_rank``
+        (imported via the ops/__init__ re-export) canonicalizes to
+        ``dmosopt_tpu.ops.dominance.non_dominated_rank``. Returns the
+        name if it lands in ``index``, else None."""
+        seen: Set[str] = set()
+        while dotted and dotted not in index and dotted not in seen:
+            seen.add(dotted)
+            # longest module prefix that is an analyzed module
+            parts = dotted.split(".")
+            hop = None
+            for cut in range(len(parts) - 1, 0, -1):
+                mod = self.modules_by_name.get(".".join(parts[:cut]))
+                if mod is None:
+                    continue
+                target = mod.aliases.get(parts[cut])
+                if target is not None:
+                    hop = ".".join([target] + parts[cut + 1:])
+                break
+            if hop is None:
+                return None
+            dotted = hop
+        return dotted if dotted in index else None
+
+    def _build_class_relatives(self):
+        """For each class: itself + transitive ancestors + transitive
+        descendants — the set dynamic ``self.method`` dispatch can land
+        in. Base names may themselves be re-exports."""
+        bases: Dict[str, Set[str]] = {}
+        children: Dict[str, Set[str]] = {}
+        for cls, base_list in self.classes.items():
+            for b in base_list:
+                canon = self.resolve_symbol(b, self.classes)
+                if canon is not None:
+                    bases.setdefault(cls, set()).add(canon)
+                    children.setdefault(canon, set()).add(cls)
+
+        def walk(start: str, edges: Dict[str, Set[str]]) -> Set[str]:
+            out: Set[str] = set()
+            stack = [start]
+            while stack:
+                for nxt in edges.get(stack.pop(), ()):
+                    if nxt not in out:
+                        out.add(nxt)
+                        stack.append(nxt)
+            return out
+
+        for cls in self.classes:
+            self.class_relatives[cls] = (
+                {cls} | walk(cls, bases) | walk(cls, children)
+            )
+
+    def _propagate_hot(self):
+        # seeds: jit entries and traced bodies; nested defs inherit
+        work: List[FunctionInfo] = []
+        for info in self.functions.values():
+            if info.jit_entry or info.traced_body:
+                info.hot = True
+                info.hot_via = "jit entry" if info.jit_entry else "traced body"
+                work.append(info)
+        while work:
+            f = work.pop()
+            targets = set()
+            for mod_fn in list(self.functions.values()):
+                if mod_fn.parent is f:  # defined inside a jit region
+                    targets.add((mod_fn, f"defined inside {f.full_name}"))
+            for name in f.calls | f.refs:
+                g = self.functions.get(name)
+                if g is not None:
+                    targets.add((g, f"reached from {f.full_name}"))
+            for g, via in targets:
+                if not g.hot:
+                    g.hot = True
+                    g.hot_via = via
+                    work.append(g)
+
+    # -------------------------------------------------------- queries
+
+    def hot_functions(self) -> List[FunctionInfo]:
+        return [f for f in self.functions.values() if f.hot]
+
+    def resolve_call(self, mod: Module, node: ast.Call) -> Optional[str]:
+        """Canonical dotted name of a call's target (import-aliased)."""
+        return mod.resolve(node.func)
+
+    # ------------------------------------------------------- findings
+
+    def emit(
+        self,
+        findings: List[Finding],
+        rule: str,
+        mod: Module,
+        node: ast.AST,
+        message: str,
+        qualname: str = "",
+    ):
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        f = Finding(rule, mod.relpath, line, col, message, qualname=qualname)
+        sup = mod.suppressions.get(line)
+        if sup is not None and rule in sup.rules:
+            sup.used.add(rule)
+            f.suppressed = True
+            f.justification = sup.justification
+        findings.append(f)
+
+
+def _modname_from_relpath(rel: str) -> str:
+    parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else rel
+
+
+def _function_scope_locals(node) -> Set[str]:
+    """Names bound inside a function body (params + assignments +
+    imports + inner defs), for free-variable analysis."""
+    bound: Set[str] = set()
+    args = node.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    ):
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(
+            sub.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(sub.id)
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if sub is not node:
+                bound.add(sub.name)
+        elif isinstance(sub, ast.Import):
+            for al in sub.names:
+                bound.add(al.asname or al.name.split(".")[0])
+        elif isinstance(sub, ast.ImportFrom):
+            for al in sub.names:
+                if al.name != "*":
+                    bound.add(al.asname or al.name)
+    return bound
+
+
+def free_variables(node) -> Set[str]:
+    """Loaded names not bound within the function (nor builtins) — the
+    closure captures that defeat jit's by-identity trace cache."""
+    import builtins
+
+    bound = _function_scope_locals(node)
+    free: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            if sub.id not in bound and not hasattr(builtins, sub.id):
+                free.add(sub.id)
+    return free
+
+
+def _collect_module_globals(tree: ast.Module) -> Set[str]:
+    """Names bound at module scope (assignments, defs, classes, import
+    aliases, loop targets) — module globals are stable across calls, so
+    a nested jit closing over one is NOT a per-call capture."""
+    bound: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            bound.add(stmt.name)
+            continue
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                bound.add(sub.name)
+            elif isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, (ast.Store, ast.Del)
+            ):
+                bound.add(sub.id)
+            elif isinstance(sub, ast.Import):
+                for al in sub.names:
+                    bound.add(al.asname or al.name.split(".")[0])
+            elif isinstance(sub, ast.ImportFrom):
+                for al in sub.names:
+                    if al.name != "*":
+                        bound.add(al.asname or al.name)
+    return bound
+
+
+def module_scope(mod: Module) -> FunctionInfo:
+    """A synthetic FunctionInfo over a module's top-level statements
+    (``iter_body_nodes`` skips nested function/class bodies), so rules
+    can scan module-level code with the same machinery."""
+    return FunctionInfo(
+        module=mod, qualname="<module>", node=mod.tree,
+        parent=None, class_name=None,
+    )
+
+
+def iter_body_nodes(info: FunctionInfo):
+    """Walk a function's own body, *excluding* nested function/lambda
+    bodies (those are separate FunctionInfos, visited on their own).
+    Class *bodies* are descended: class-scope statements (``step =
+    jax.jit(kern)``, a class-level ``json.dumps``) execute in the
+    enclosing scope at definition time — only the method defs inside
+    are separate scopes."""
+    body = info.node.body
+    stack: List[ast.AST] = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+        )):
+            continue  # separate scope
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _lambda_binding_targets(
+    ctx: LintContext, info: FunctionInfo, name: str
+) -> List[str]:
+    """Functions referenced inside a lambda bound to local ``name`` in
+    ``info`` or an enclosing scope — ``loss_fn = lambda p: -_elbo(p)``
+    then ``jax.grad(loss_fn)`` inside a nested jit region must still
+    mark ``_elbo`` traced."""
+    scope = info
+    while scope is not None:
+        for node in iter_body_nodes(scope):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == name for t in targets
+            ):
+                continue
+            out: List[str] = []
+            for sub in ast.walk(value):
+                if not isinstance(sub, ast.Lambda):
+                    continue
+                for inner in ast.walk(sub):
+                    if isinstance(inner, (ast.Name, ast.Attribute)) and (
+                        isinstance(getattr(inner, "ctx", None), ast.Load)
+                    ):
+                        out.extend(_function_targets(
+                            ctx, scope, inner, follow_lambdas=False
+                        ))
+            if out:
+                return out
+        scope = scope.parent
+    return []
+
+
+def _function_targets(
+    ctx: LintContext, info: FunctionInfo, node: ast.AST,
+    follow_lambdas: bool = True,
+) -> List[str]:
+    """Resolve a Name/Attribute to functions *in the analyzed set*:
+    enclosing-scope / module-level / imported (re-exports chased) /
+    ``self.method`` (fanned out over the class hierarchy — dynamic
+    dispatch can land the call in any ancestor's or descendant's
+    override, so all of them are edges) / locals bound to lambdas
+    (resolved to the functions the lambda references) / inline lambdas
+    (their own synthetic scope)."""
+    mod = info.module
+    if isinstance(node, ast.Lambda):
+        linfo = mod.lambda_infos.get(id(node))
+        return [linfo.full_name] if linfo is not None else []
+    # self.method() / cls.method() -> every override in the hierarchy
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+        and info.class_name
+    ):
+        own = f"{mod.modname}.{info.class_name}"
+        out = []
+        for cls in sorted(ctx.class_relatives.get(own, {own})):
+            cand = f"{cls}.{node.attr}"
+            if cand in ctx.functions:
+                out.append(cand)
+        return out
+    if isinstance(node, ast.Name):
+        # enclosing function scope chain, innermost first
+        scope = info
+        while scope is not None:
+            cand = f"{mod.modname}.{scope.qualname}.{node.id}"
+            if cand in ctx.functions:
+                return [cand]
+            scope = scope.parent
+        cand = f"{mod.modname}.{node.id}"
+        if cand in ctx.functions:
+            return [cand]
+        target = ctx.resolve_symbol(mod.aliases.get(node.id), ctx.functions)
+        if target:
+            return [target]
+        if follow_lambdas:
+            return _lambda_binding_targets(ctx, info, node.id)
+        return []
+    if isinstance(node, ast.Attribute):
+        dotted = ctx.resolve_symbol(mod.resolve(node), ctx.functions)
+        return [dotted] if dotted else []
+    return []
+
+
+def _collect_edges(ctx: LintContext, info: FunctionInfo):
+    """Record call edges, function references, jit call-form entries and
+    traced-callable registrations found in ``info``'s body."""
+    mod = info.module
+    for node in iter_body_nodes(info):
+        if isinstance(node, ast.Call):
+            canon = mod.resolve(node.func)
+            info.calls.update(_function_targets(ctx, info, node.func))
+            # jax.jit(fn) call form -> fn is a compiled entry point
+            if canon in JIT_WRAPPERS or canon in CUSTOM_DERIV:
+                for arg in node.args[:1]:
+                    for t in _function_targets(ctx, info, arg):
+                        ctx.functions[t].jit_entry = True
+            # combinators: designated args are traced bodies
+            if canon in TRACED_CALLABLE_ARGS:
+                for idx in TRACED_CALLABLE_ARGS[canon]:
+                    if idx < len(node.args):
+                        for t in _function_targets(ctx, info, node.args[idx]):
+                            ctx.functions[t].traced_body = True
+            elif canon in SWITCH_LIKE:
+                for arg in node.args[1:]:
+                    for t in _function_targets(ctx, info, arg):
+                        ctx.functions[t].traced_body = True
+            # custom_vjp fwd/bwd registration
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in DERIV_REGISTER_METHODS
+            ):
+                for arg in node.args:
+                    for t in _function_targets(ctx, info, arg):
+                        ctx.functions[t].traced_body = True
+            # plain function-valued arguments (higher-order helpers that
+            # trace their callable, e.g. _scan_with_convergence(step, ...))
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                info.refs.update(_function_targets(ctx, info, arg))
+        elif isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), ast.Load
+        ):
+            info.refs.update(_function_targets(ctx, info, node))
+
+
+# ------------------------------------------------------- frozen hashes
+
+
+def frozen_hash(node) -> str:
+    """SHA-256 of a function's *normalized* source: the AST dump with
+    positions stripped and the docstring removed — comment / whitespace
+    / relocation churn never trips the guard, any code or decorator
+    change does."""
+    node = copy.deepcopy(node)
+    if (
+        node.body
+        and isinstance(node.body[0], ast.Expr)
+        and isinstance(node.body[0].value, ast.Constant)
+        and isinstance(node.body[0].value.value, str)
+    ):
+        node.body = node.body[1:] or [ast.Pass()]
+    dump = ast.dump(node, include_attributes=False)
+    return hashlib.sha256(dump.encode()).hexdigest()
+
+
+# ------------------------------------------------------------- running
+
+DEFAULT_TARGETS = ("dmosopt_tpu", "bench.py", "__graft_entry__.py")
+
+
+def _iter_target_files(repo_root: Path, targets: Iterable[str]) -> List[Path]:
+    files: List[Path] = []
+    root = repo_root.resolve()
+    for t in targets:
+        p = Path(t)
+        p = (p if p.is_absolute() else repo_root / p).resolve()
+        try:
+            p.relative_to(root)
+        except ValueError:
+            raise ValueError(
+                f"lint target '{t}' is outside the repo root {root} — "
+                f"module names (and the frozen registry) are anchored to "
+                f"the repo layout"
+            ) from None
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.is_file():
+            files.append(p)
+        else:
+            # a typo'd path (or a renamed DEFAULT_TARGETS entry) must
+            # not let the gate pass green while linting nothing
+            raise ValueError(f"lint target '{t}' does not exist")
+    seen: Set[Path] = set()
+    unique: List[Path] = []
+    for f in files:  # overlapping targets (dir + file inside it) dedupe
+        if f not in seen:
+            seen.add(f)
+            unique.append(f)
+    return unique
+
+
+def load_context(
+    repo_root: Path,
+    targets: Iterable[str] = DEFAULT_TARGETS,
+    options: Optional[dict] = None,
+) -> LintContext:
+    ctx = LintContext(Path(repo_root))
+    if options:
+        ctx.options.update(options)
+    for f in _iter_target_files(ctx.repo_root, targets):
+        ctx.add_file(f)
+    ctx.finalize()
+    return ctx
+
+
+def run_lint(
+    repo_root: Path,
+    targets: Iterable[str] = DEFAULT_TARGETS,
+    rules: Optional[Iterable[str]] = None,
+    options: Optional[dict] = None,
+) -> List[Finding]:
+    """Parse targets, run the selected rules (default: all registered),
+    and return every finding — suppressed ones included, flagged.
+
+    Appends ``suppression-hygiene`` findings for directives that lack a
+    justification, name an unknown rule, or never matched a finding.
+    """
+    from tools.graftlint.registry import all_rules
+
+    ctx = load_context(repo_root, targets, options=options)
+    findings: List[Finding] = list(ctx.parse_errors)
+    active = all_rules(rules)
+    for rule in active:
+        findings.extend(rule.check(ctx))
+    known = {r.name for r in all_rules(None)}
+    selected = {r.name for r in active}
+    # the unused-suppression check is only meaningful over the full
+    # default target set: with a partial path list, hot marks that come
+    # from callers outside the targets are missing, so suppressions the
+    # full `make lint` run requires would be reported as stale (fixture
+    # runs opt in via options={"check_unused": True})
+    check_unused = bool(ctx.options.get(
+        "check_unused", tuple(targets) == tuple(DEFAULT_TARGETS)
+    ))
+    for mod in ctx.modules:
+        for sup in mod.suppressions.values():
+            if not sup.justification:
+                findings.append(Finding(
+                    "suppression-hygiene", mod.relpath, sup.line, 0,
+                    "suppression lacks a justification: write "
+                    "'# graftlint: disable=<rule> -- <why this exception "
+                    "is deliberate>'",
+                ))
+            for r in sup.rules:
+                if r not in known:
+                    findings.append(Finding(
+                        "suppression-hygiene", mod.relpath, sup.line, 0,
+                        f"suppression names unknown rule '{r}'",
+                    ))
+            if check_unused:
+                stale = [
+                    r for r in sup.rules
+                    if r in selected and r in known and r not in sup.used
+                ]
+                if stale:
+                    findings.append(Finding(
+                        "suppression-hygiene", mod.relpath, sup.line, 0,
+                        f"unused suppression for {','.join(stale)}: nothing "
+                        "fires on this line — delete the stale rule name(s)",
+                    ))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

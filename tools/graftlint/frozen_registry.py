@@ -1,0 +1,66 @@
+"""The frozen-path registry: bitwise-frozen functions and their baked
+normalized-source hashes (see rules/frozen_path.py for the hash
+definition and docs/static-analysis.md for the bump procedure).
+
+The seed set names exactly the paths the seeded-trajectory / parity pin
+tests already freeze, so the registry and the SHA-256 pins guard the
+same program text from both sides: the pins catch numeric drift at run
+time, this registry catches the source edit at lint time.
+"""
+
+FROZEN = {
+    # PR 5: the solve-regime predictor is the frozen oracle every other
+    # predict regime (matmul, nystrom) is parity-pinned against.
+    "dmosopt_tpu.models.gp.gp_predict": {
+        "sha256": "cf74d08b7a4be99acb96270b27ffeed3d8d55b422a674a1446ec85bc84b867be",
+        "reason": "solve-regime predict oracle; default path of every "
+                  "exact-GP surrogate — an ulp of drift breaks the baked "
+                  "zdt1 driver-trajectory hash",
+        "pinned_by": "tests/test_gp_predictor.py::"
+                     "test_default_solve_trajectory_bitwise_pinned",
+    },
+    # PR 4: the cold fit is the default surrogate_refit="cold" program;
+    # warm/rank paths are pinned bitwise against it.
+    "dmosopt_tpu.models.gp.fit_gp_batch": {
+        "sha256": "188c5cf5e81a7b34bc2dc3ed98ee9dd789e208eecae60bf5bf9520c31ed1a083",
+        "reason": "cold-fit path; surrogate_refit='cold' default is "
+                  "pinned bitwise vs HEAD at fit and seeded-trajectory "
+                  "level",
+        "pinned_by": "tests/test_gp_refit.py (cold bitwise fit + "
+                     "trajectory regressions)",
+    },
+    # PR 3: the dense dominance-degree peel is the oracle both live rank
+    # routes are bitwise equivalence-pinned against.
+    "dmosopt_tpu.ops.dominance._rank_matrix_peel": {
+        "sha256": "738082444c074551ed28be00548d58148780344fa37278208e91bbc0224b59c6",
+        "reason": "dense dominance oracle; the tiled sweep and the d==2 "
+                  "sweep are bitwise-pinned against it",
+        "pinned_by": "tests/test_ops.py rank equivalence pins",
+    },
+    # PR 2/3: the d==2 patience-sorting sweep serves every bi-objective
+    # ranking (the ZDT sweep path) and is routing-pinned.
+    "dmosopt_tpu.ops.dominance._rank_biobjective_sweep": {
+        "sha256": "b27ddd45a32347c52b2888aa149203c96100a91780b2ac70ef127ccbccfe609a",
+        "reason": "d==2 ZDT sweep; byte-identical trajectories across "
+                  "PRs depend on it (routing pinned at trace time)",
+        "pinned_by": "tests/test_ops.py + PR 5 d==2 routing count test",
+    },
+    # PR 3: the dense duplicate-mask kernel is kept VERBATIM for the
+    # single-chunk regime — wrapping the same math in lax.scan shifted
+    # fusion by an ulp and flipped borderline D <= eps comparisons
+    # (the dtlz7 HV 13.49 -> 14.54 bisection).
+    "dmosopt_tpu.ops.distances._duplicate_mask_dense": {
+        "sha256": "9f1baad4456f89f2b926c55a6e2f15747f9f42af5d0cfd53c8519e78b7b57297",
+        "reason": "dense duplicate-mask branch, frozen verbatim after "
+                  "the dtlz7 ulp/fusion trajectory bisection",
+        "pinned_by": "tests/test_ops.py dense-vs-chunked agreement pins",
+    },
+    # PR 3: the dense pairwise-distance kernel backs the single-chunk
+    # regime of every crowding/survival distance consumer.
+    "dmosopt_tpu.ops.distances._pairwise_distances_dense": {
+        "sha256": "d9a428c1b85eb10fe9cdb21b3f6a02c320c078959a462eef470f054673b8c6c8",
+        "reason": "dense pairwise-distance branch (single-chunk regime "
+                  "kept identical to the historical kernel)",
+        "pinned_by": "tests/test_ops.py dense-vs-chunked agreement pins",
+    },
+}

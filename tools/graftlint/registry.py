@@ -1,0 +1,69 @@
+"""graftlint rule registry.
+
+A rule is a class with a ``name``, a one-line ``description``, the
+incident it encodes (``incident``, shown by ``--list-rules`` and in
+docs/static-analysis.md), and a ``check(ctx) -> list[Finding]``.
+Registration is by decorator; ``all_rules()`` imports the rule modules
+on first use so the registry is populated lazily but deterministically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Type
+
+REGISTRY: Dict[str, "Rule"] = {}
+
+
+class Rule:
+    name: str = ""
+    description: str = ""
+    incident: str = ""
+
+    def check(self, ctx) -> list:
+        raise NotImplementedError
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if inst.name in REGISTRY:
+        raise ValueError(f"duplicate rule name {inst.name}")
+    REGISTRY[inst.name] = inst
+    return cls
+
+_LOADED = False
+
+
+def _load_rule_modules():
+    global _LOADED
+    if _LOADED:
+        return
+    # import order is alphabetical and irrelevant: rules are independent
+    from tools.graftlint.rules import (  # noqa: F401
+        dtype_discipline,
+        frozen_path,
+        hot_path,
+        metrics_catalog,
+        retrace_hazard,
+    )
+    _LOADED = True
+
+
+def all_rules(names: Optional[Iterable[str]] = None) -> List[Rule]:
+    _load_rule_modules()
+    if names is None:
+        return [REGISTRY[k] for k in sorted(REGISTRY)]
+    out = []
+    for n in names:
+        if n not in REGISTRY:
+            raise KeyError(
+                f"unknown rule '{n}' (known: {', '.join(sorted(REGISTRY))})"
+            )
+        out.append(REGISTRY[n])
+    return out
+
+
+def get_rule(name: str) -> Rule:
+    _load_rule_modules()
+    return REGISTRY[name]

@@ -1,0 +1,135 @@
+"""dtype-discipline: no float64 on device paths, no bare json.dump of
+numpy-bearing payloads.
+
+The BENCH_r03 crash class: the package's device programs are f32 by
+default (jax demotes f64 unless x64 is enabled, so a float64 literal on
+a device path either silently downcasts — a dtype-dependent trajectory
+hazard — or, with x64 on, doubles memory and defeats the MXU); and a
+stray ``np.float64`` scalar escaping into ``json.dump`` without a
+``default=`` coercion crashed an entire bench round. Host-side float64
+(HDF5 columns, SciPy oracles) is fine and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    Finding,
+    LintContext,
+    free_variables,
+    iter_body_nodes,
+    module_scope,
+)
+from tools.graftlint.registry import Rule, register
+
+_F64_NAMES = {
+    "numpy.float64", "numpy.double", "jax.numpy.float64", "float64",
+}
+
+
+def _is_float64_expr(mod, node) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    canon = mod.resolve(node)
+    return canon in _F64_NAMES
+
+
+@register
+class DtypeDisciplineRule(Rule):
+    name = "dtype-discipline"
+    description = (
+        "no float64 literals/np.float64 defaults on device paths; "
+        "json.dump of numpy-bearing payloads needs a default= coercion"
+    )
+    incident = (
+        "BENCH_r03: a numpy float64 scalar reaching json.dump crashed "
+        "the bench round; f64 on a device path silently downcasts or "
+        "doubles memory"
+    )
+
+    def check(self, ctx: LintContext):
+        findings: list[Finding] = []
+        # (a) any float64 reference inside a jit region
+        for info in ctx.hot_functions():
+            mod = info.module
+            free = free_variables(info.node)
+            for node in iter_body_nodes(info):
+                # Attribute (np.float64) or an imported bare name
+                # (`from numpy import float64`); a *local* merely named
+                # float64 is bound in the function, hence not free, and
+                # is not flagged
+                if (
+                    isinstance(node, ast.Attribute)
+                    or (
+                        isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in mod.aliases
+                        and node.id in free
+                    )
+                ) and mod.resolve(node) in _F64_NAMES:
+                    ctx.emit(
+                        findings, self.name, mod, node,
+                        f"'{mod.resolve(node)}' inside a jit region "
+                        f"({info.hot_via}): device paths are f32 — a f64 "
+                        f"literal silently downcasts (or doubles memory "
+                        f"under x64)",
+                        qualname=info.full_name,
+                    )
+                elif isinstance(node, ast.keyword) and node.arg == "dtype":
+                    if (
+                        isinstance(node.value, ast.Constant)
+                        and node.value.value == "float64"
+                    ):
+                        ctx.emit(
+                            findings, self.name, mod, node.value,
+                            "dtype=\"float64\" inside a jit region",
+                            qualname=info.full_name,
+                        )
+        # (b) jnp constructors handed a float64 dtype anywhere — module
+        # scope included (eager device allocation in f64 — the r03
+        # dtype-conversion class)
+        for mod in ctx.modules:
+            for info in list(mod.functions.values()) + [module_scope(mod)]:
+                if info.hot:
+                    continue  # already covered with a sharper message
+                for node in iter_body_nodes(info):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    canon = mod.resolve(node.func)
+                    if not (canon and canon.startswith("jax.numpy.")):
+                        continue
+                    for kw in node.keywords:
+                        if kw.arg == "dtype" and _is_float64_expr(mod, kw.value):
+                            ctx.emit(
+                                findings, self.name, mod, node,
+                                f"'{canon}' allocates in float64 on the "
+                                f"device — use f32 (or an explicit host "
+                                f"numpy array)",
+                                qualname=info.full_name,
+                            )
+        # (c) bare json.dump(s) in modules that traffic in numpy/jax
+        # values: numpy scalars are not JSON-serializable (BENCH_r03) —
+        # pass default= (see bench._json_default)
+        for mod in ctx.modules:
+            imports_np = any(
+                t in ("numpy", "jax", "jax.numpy")
+                for t in mod.aliases.values()
+            )
+            if not imports_np:
+                continue
+            for info in list(mod.functions.values()) + [module_scope(mod)]:
+                for node in iter_body_nodes(info):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if mod.resolve(node.func) in ("json.dump", "json.dumps"):
+                        if not any(k.arg == "default" for k in node.keywords):
+                            ctx.emit(
+                                findings, self.name, mod, node,
+                                "bare json.dump(s) in a numpy-importing "
+                                "module: a stray np.float64 scalar in the "
+                                "payload raises TypeError (BENCH_r03) — "
+                                "pass default= (cf. bench._json_default)",
+                                qualname=info.full_name,
+                            )
+        return findings

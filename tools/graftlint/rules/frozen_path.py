@@ -1,0 +1,89 @@
+"""frozen-path-guard: bitwise-frozen functions may not change silently.
+
+The PR 3 dtlz7 bisection: wrapping the *same math* in a ``lax.scan``
+shifted XLA's fusion by an ulp, flipped borderline ``D <= eps``
+comparisons, and silently broke a seeded trajectory (HV 13.49 → 14.54).
+Since then the default numeric paths are bitwise-frozen and pinned by
+seeded-trajectory tests. This rule is the source-side arm of those
+pins: every function in ``tools/graftlint/frozen_registry.py`` carries
+a baked hash of its *normalized* source (AST dump, docstring and
+comments stripped — formatting churn never trips it, any code or
+decorator change does). Editing a registered function without bumping
+the registry turns ``make lint`` red before the (slow) trajectory pins
+ever run.
+
+Bump procedure (docs/static-analysis.md): run
+``python -m tools.graftlint --frozen-hashes``, copy the new hash into
+the registry entry, and say *why* the change preserves (or knowingly
+re-baselines) the frozen behavior in the entry's ``reason``.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.engine import Finding, LintContext, frozen_hash
+from tools.graftlint.registry import Rule, register
+
+
+@register
+class FrozenPathRule(Rule):
+    name = "frozen-path-guard"
+    description = (
+        "registered bitwise-frozen functions must match their baked "
+        "source hash; bump tools/graftlint/frozen_registry.py to change "
+        "one deliberately"
+    )
+    incident = (
+        "PR 3 dtlz7 HV bisection: an ulp of XLA fusion drift from an "
+        "innocent-looking rewrite silently broke seeded trajectories"
+    )
+
+    def registry(self, ctx: LintContext) -> dict:
+        override = ctx.options.get("frozen_registry")
+        if override is not None:
+            return override
+        from tools.graftlint.frozen_registry import FROZEN
+
+        return FROZEN
+
+    def check(self, ctx: LintContext):
+        findings: list[Finding] = []
+        for fullname, entry in sorted(self.registry(ctx).items()):
+            info = ctx.functions.get(fullname)
+            if info is None:
+                # anchor to the module that lost the function: the
+                # LONGEST modname prefix (plain startswith would land on
+                # the package __init__, which prefixes everything)
+                mod = max(
+                    (
+                        m for m in ctx.modules
+                        if fullname.startswith(m.modname + ".")
+                    ),
+                    key=lambda m: len(m.modname),
+                    default=None,
+                )
+                if mod is None:
+                    # the registered module isn't in this lint target set
+                    # (e.g. fixture runs over a single file): skip, the
+                    # full `make lint` run covers it
+                    continue
+                ctx.emit(
+                    findings, self.name, mod, mod.tree,
+                    f"frozen function '{fullname}' not found — renamed or "
+                    f"deleted without updating the registry "
+                    f"(tools/graftlint/frozen_registry.py)",
+                )
+                continue
+            actual = frozen_hash(info.node)
+            if actual != entry["sha256"]:
+                ctx.emit(
+                    findings, self.name, info.module, info.node,
+                    f"frozen function '{fullname}' changed: normalized "
+                    f"source hash {actual[:12]}… != registered "
+                    f"{entry['sha256'][:12]}… (frozen because: "
+                    f"{entry['reason']}; pinned by {entry['pinned_by']}). "
+                    f"If the change is deliberate, re-run the pin tests "
+                    f"and bump the registry hash with a rationale "
+                    f"(`python -m tools.graftlint --frozen-hashes`)",
+                    qualname=fullname,
+                )
+        return findings

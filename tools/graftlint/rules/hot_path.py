@@ -1,0 +1,128 @@
+"""hot-path-purity: no host-side effects inside jit regions.
+
+The PR 1/3/5/6 hook discipline: telemetry is eager-only and
+hook-attached — emission calls, ``print``, host clocks, ``.item()`` /
+``.tolist()`` host transfers, ``np.asarray``-on-tracer and file I/O
+must never appear in a function whose body is traced. Inside a trace
+they either fail (numpy on a tracer), silently measure tracing instead
+of execution (clocks), or fire once per *compilation* instead of once
+per call (counters) — the exact bug class the telemetry layer's
+attach/detach hook pattern exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import Finding, LintContext, iter_body_nodes
+from tools.graftlint.registry import Rule, register
+
+#: builtins whose call in a traced body is a host effect
+_HOST_BUILTINS = {"print", "input", "breakpoint", "open"}
+
+#: host clocks: inside a trace these time *tracing*, not execution
+_HOST_CLOCKS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.sleep",
+}
+
+#: attribute calls that force a device->host transfer / sync
+_TRANSFER_METHODS = {"item", "tolist", "block_until_ready"}
+
+#: numpy entry points that concretize their argument (fail on tracers)
+_NUMPY_COERCIONS = {
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "numpy.copy", "numpy.save", "numpy.savetxt", "numpy.asfortranarray",
+}
+
+#: telemetry emission methods (facade + registry), string-literal-named
+_EMIT_METHODS = {
+    "inc", "gauge", "observe", "event",
+    "counter_inc", "gauge_set", "histogram_observe",
+}
+
+#: module-level telemetry helpers that are likewise eager-only
+_TELEMETRY_HELPERS = ("telemetry.phase_scope", "telemetry.record_device_memory")
+
+#: logging methods on objects plausibly being loggers
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}
+_LOGGERISH_NAMES = {"logging", "logger", "log"}
+
+
+@register
+class HotPathPurityRule(Rule):
+    name = "hot-path-purity"
+    description = (
+        "no eager telemetry, print, host clocks, .item()/.tolist(), "
+        "np.asarray-on-tracer, or host I/O inside jit regions"
+    )
+    incident = (
+        "PR 1/3/5/6 hook discipline: telemetry counters inside a traced "
+        "body fire once per compilation, not per call; numpy coercions "
+        "raise TracerArrayConversionError mid-epoch"
+    )
+
+    def check(self, ctx: LintContext):
+        findings: list[Finding] = []
+        for info in ctx.hot_functions():
+            mod = info.module
+            for node in iter_body_nodes(info):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = mod.resolve(node.func)
+                msg = None
+                if isinstance(node.func, ast.Name) and node.func.id in _HOST_BUILTINS:
+                    if node.func.id not in mod.aliases:  # not shadowed
+                        msg = (
+                            f"host call '{node.func.id}()' inside a jit "
+                            f"region ({info.hot_via})"
+                        )
+                elif canon in _HOST_CLOCKS:
+                    msg = (
+                        f"host clock '{canon}' inside a jit region times "
+                        f"tracing, not execution ({info.hot_via})"
+                    )
+                elif canon in _NUMPY_COERCIONS:
+                    msg = (
+                        f"'{canon}' concretizes its argument — raises on "
+                        f"a tracer inside a jit region ({info.hot_via})"
+                    )
+                elif canon and any(canon.endswith(h) for h in _TELEMETRY_HELPERS):
+                    msg = (
+                        f"telemetry helper '{canon}' inside a jit region "
+                        f"({info.hot_via})"
+                    )
+                elif isinstance(node.func, ast.Attribute):
+                    attr = node.func.attr
+                    if attr in _TRANSFER_METHODS:
+                        msg = (
+                            f".{attr}() forces a device->host sync inside "
+                            f"a jit region ({info.hot_via})"
+                        )
+                    elif (
+                        attr in _EMIT_METHODS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                    ):
+                        msg = (
+                            f"telemetry emission .{attr}"
+                            f"('{node.args[0].value}') inside a jit region "
+                            f"— fires per compilation, not per call "
+                            f"({info.hot_via}); attach via an eager hook "
+                            f"instead"
+                        )
+                    elif attr in _LOG_METHODS and (
+                        isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in _LOGGERISH_NAMES
+                    ):
+                        msg = (
+                            f"logging call '.{attr}()' inside a jit "
+                            f"region ({info.hot_via})"
+                        )
+                if msg:
+                    ctx.emit(
+                        findings, self.name, mod, node, msg,
+                        qualname=info.full_name,
+                    )
+        return findings

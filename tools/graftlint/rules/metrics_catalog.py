@@ -1,0 +1,107 @@
+"""metrics-catalog: every emitted metric name is documented.
+
+The AST-based absorption of ``tools/lint_metrics.py`` (which now
+delegates here, keeping ``make lint-metrics`` and the fast-suite hook
+working unchanged): every telemetry emission in the package — the
+facade's ``.inc(`` / ``.gauge(`` / ``.observe(`` and the registry's
+``.counter_inc(`` / ``.gauge_set(`` / ``.histogram_observe(`` — whose
+first argument is a string literal must be backticked somewhere in
+``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.graftlint.engine import Finding, LintContext
+from tools.graftlint.registry import Rule, register
+
+EMIT_METHODS = (
+    "inc", "gauge", "observe",
+    "counter_inc", "gauge_set", "histogram_observe",
+)
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+CATALOG_RELPATH = Path("docs") / "observability.md"
+
+
+def emissions_in_tree(tree: ast.AST):
+    """Yield ``(name, node)`` for every telemetry emission call in a
+    parsed module: ``.<method>('snake_case_name', ...)``."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in EMIT_METHODS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and _NAME_RE.match(node.args[0].value)
+        ):
+            yield node.args[0].value, node
+
+
+def catalog_names(doc_path: Path) -> set:
+    """Every backticked snake_case token in the catalog doc."""
+    return set(re.findall(r"`([a-z][a-z0-9_]*)`", Path(doc_path).read_text()))
+
+
+def emitted_metrics(package_root: Path) -> dict:
+    """{metric_name: [repo-relative files emitting it]} — the
+    standalone-scan entry point ``tools/lint_metrics.py`` re-exports."""
+    package_root = Path(package_root)
+    repo = package_root.parent
+    names: dict = {}
+    for path in sorted(package_root.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except (SyntaxError, UnicodeDecodeError, ValueError):
+            # same tolerance as the engine's add_file: an unparsable
+            # file is graftlint's parse-error finding, not a lint crash
+            continue
+        for name, _ in emissions_in_tree(tree):
+            names.setdefault(name, []).append(str(path.relative_to(repo)))
+    return names
+
+
+def check(package_root: Path, doc_path: Path) -> list:
+    """[(name, sorted files)] for emitted metrics missing from the doc."""
+    catalog = catalog_names(doc_path)
+    return sorted(
+        (name, sorted(set(files)))
+        for name, files in emitted_metrics(package_root).items()
+        if name not in catalog
+    )
+
+
+@register
+class MetricsCatalogRule(Rule):
+    name = "metrics-catalog"
+    description = (
+        "every telemetry metric name emitted in the package is "
+        "backticked in docs/observability.md"
+    )
+    incident = (
+        "PR 1 observability contract: an uncataloged metric is invisible "
+        "to the telemetry CLI consumers and rots undocumented"
+    )
+
+    def check(self, ctx: LintContext):
+        findings: list[Finding] = []
+        doc = ctx.repo_root / CATALOG_RELPATH
+        if not doc.is_file():
+            return findings  # fixture runs without a docs tree
+        catalog = catalog_names(doc)
+        for mod in ctx.modules:
+            if not mod.modname.startswith("dmosopt_tpu"):
+                continue  # the catalog documents the package, not bench
+            for name, node in emissions_in_tree(mod.tree):
+                if name not in catalog:
+                    ctx.emit(
+                        findings, self.name, mod, node,
+                        f"metric '{name}' is emitted here but not "
+                        f"cataloged in {CATALOG_RELPATH} — document it "
+                        f"(name, type, labels, when it moves)",
+                    )
+        return findings

@@ -1,0 +1,171 @@
+"""retrace-hazard: patterns that defeat jit's by-identity trace cache.
+
+``utils/compile_cache.py`` persists XLA binaries across runs, but jax's
+in-process trace cache is keyed by *function object identity* plus
+static argument values. A ``jax.jit`` constructed inside a loop, a
+``jit(lambda ...)`` built per call, or a jit-decorated closure over
+enclosing-scope Python values produces a fresh callable every time —
+every invocation retraces (and under the persistent cache, re-hashes
+and re-loads), turning a microseconds-hot path into a
+milliseconds-compile path. Non-hashable static args raise at call time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import (
+    Finding,
+    LintContext,
+    _is_jit_expr,
+    free_variables,
+)
+from tools.graftlint.registry import Rule, register
+
+
+def _static_param_names(mod, dec) -> list:
+    """static_argnames literals on a jit decorator call, if present."""
+    if not isinstance(dec, ast.Call):
+        return []
+    names = []
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.append(v.value)
+    return names
+
+
+@register
+class RetraceHazardRule(Rule):
+    name = "retrace-hazard"
+    description = (
+        "no jit construction in loops, jit(lambda) per call, "
+        "jit closures over enclosing locals, or mutable static-arg "
+        "defaults"
+    )
+    incident = (
+        "a per-iteration jax.jit defeats both the in-process trace "
+        "cache (identity-keyed) and utils/compile_cache.py — every call "
+        "recompiles"
+    )
+
+    def check(self, ctx: LintContext):
+        findings: list[Finding] = []
+        for mod in ctx.modules:
+            for info in mod.functions.values():
+                self._check_function(ctx, findings, info)
+        return findings
+
+    def _check_function(self, ctx, findings, info):
+        mod = info.module
+        if isinstance(info.node, ast.Lambda):
+            # lambdas have no decorators/defaults; jit(lambda) and
+            # in-loop construction are caught at the enclosing scope
+            return
+
+        # (a) jit-decorated def nested in a function: jit's trace cache
+        # is keyed by function-object identity, so EVERY nested jit def
+        # is a fresh callable (= full retrace) per outer call — with
+        # enclosing-local captures named when present (they are also
+        # why hoisting alone wouldn't compile)
+        if info.parent is not None and any(
+            _is_jit_expr(mod, d) for d in info.node.decorator_list
+        ):
+            free = sorted(
+                v for v in free_variables(info.node)
+                if v not in mod.aliases  # imports are stable module state
+                and v not in mod.global_names  # as are module globals
+                and f"{mod.modname}.{v}" not in ctx.functions
+            )
+            detail = (
+                f"captures enclosing locals {free}: pass them as "
+                f"(static) arguments or cache the closure on its config"
+                if free else
+                "hoist it to module scope"
+            )
+            ctx.emit(
+                findings, self.name, mod, info.node,
+                f"jit-decorated def '{info.qualname}' nested in "
+                f"'{info.parent.qualname}': a new callable — and a full "
+                f"retrace — per outer call (jit's cache is keyed by "
+                f"function identity); {detail}",
+                qualname=info.full_name,
+            )
+
+        # (b) mutable defaults on static params of a jit function
+        static_names: set = set()
+        for dec in info.node.decorator_list:
+            static_names.update(_static_param_names(mod, dec))
+        if static_names:
+            args = info.node.args
+            pos = list(args.posonlyargs) + list(args.args)
+            defaults = list(args.defaults)
+            pairs = list(zip(pos[len(pos) - len(defaults):], defaults))
+            pairs += [
+                (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                if d is not None
+            ]
+            for a, d in pairs:
+                if a.arg in static_names and isinstance(
+                    d, (ast.List, ast.Dict, ast.Set)
+                ):
+                    ctx.emit(
+                        findings, self.name, mod, d,
+                        f"static arg '{a.arg}' of jit function "
+                        f"'{info.qualname}' has a non-hashable default "
+                        f"({type(d).__name__.lower()} literal) — jit "
+                        f"static args must be hashable; use a tuple or "
+                        f"None-sentinel",
+                        qualname=info.full_name,
+                    )
+
+        # (c)/(d) walk the body tracking loop depth: jit construction
+        # (call form, decorated def, or jit(lambda)) inside a loop
+        for stmt in info.node.body:
+            self._visit(ctx, findings, info, stmt, 0)
+
+    def _visit(self, ctx, findings, info, node, depth):
+        mod = info.module
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for child in ast.iter_child_nodes(node):
+                self._visit(ctx, findings, info, child, depth + 1)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if depth > 0 and any(
+                _is_jit_expr(mod, d) for d in node.decorator_list
+            ):
+                ctx.emit(
+                    findings, self.name, mod, node,
+                    f"jit-decorated def '{node.name}' inside a loop body: "
+                    f"a new callable per iteration — every iteration "
+                    f"retraces",
+                    qualname=info.full_name,
+                )
+            return  # nested scope checked via its own FunctionInfo
+        if isinstance(node, ast.ClassDef):
+            return
+        if isinstance(node, ast.Call) and _is_jit_expr(mod, node.func):
+            if depth > 0:
+                ctx.emit(
+                    findings, self.name, mod, node,
+                    "jax.jit(...) constructed inside a loop body: the "
+                    "wrapper (and its trace cache) is rebuilt per "
+                    "iteration — hoist it out of the loop",
+                    qualname=info.full_name,
+                )
+            elif node.args and isinstance(node.args[0], ast.Lambda):
+                ctx.emit(
+                    findings, self.name, mod, node,
+                    "jax.jit(lambda ...) builds a fresh callable per "
+                    "evaluation — every call retraces; name the function "
+                    "at module scope",
+                    qualname=info.full_name,
+                )
+        for child in ast.iter_child_nodes(node):
+            self._visit(ctx, findings, info, child, depth)

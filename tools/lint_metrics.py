@@ -2,18 +2,19 @@
 """Metric-name lint: every metric emitted by the package must appear in
 the docs/observability.md catalog.
 
-Scans dmosopt_tpu/**/*.py for telemetry emission calls — the facade's
-``.inc(`` / ``.gauge(`` / ``.observe(`` and the registry's
-``.counter_inc(`` / ``.gauge_set(`` / ``.histogram_observe(`` — whose
-first argument is a string literal, and checks each name is backticked
-somewhere in the catalog doc. Run directly (exit 1 on missing names) or
-via ``make lint-metrics``; the fast test suite runs it too
-(tests/test_telemetry.py).
+Since graftlint absorbed this check as its ``metrics-catalog`` rule,
+this file is a thin alias over ``tools.graftlint.rules.metrics_catalog``
+— same public functions (``emitted_metrics`` / ``catalog_names`` /
+``check``), same output, same exit codes — kept so ``make lint-metrics``
+and the fast-suite hook (tests/test_telemetry.py) work unchanged. The
+scan is now AST-based rather than regex-based: an emission is a
+``.inc(`` / ``.gauge(`` / ``.observe(`` / ``.counter_inc(`` /
+``.gauge_set(`` / ``.histogram_observe(`` call whose first argument is
+a snake_case string literal.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
@@ -21,38 +22,25 @@ REPO = Path(__file__).resolve().parent.parent
 PACKAGE = REPO / "dmosopt_tpu"
 CATALOG = REPO / "docs" / "observability.md"
 
-# an emission: method call with a lowercase snake_case string literal as
-# the first argument (\s matches newlines, so wrapped calls count)
-EMIT_RE = re.compile(
-    r"\.(?:inc|gauge|observe|counter_inc|gauge_set|histogram_observe)"
-    r"\(\s*['\"]([a-z][a-z0-9_]*)['\"]"
-)
+if str(REPO) not in sys.path:  # direct `python tools/lint_metrics.py` runs
+    sys.path.insert(0, str(REPO))
+
+from tools.graftlint.rules import metrics_catalog as _rule  # noqa: E402
 
 
 def emitted_metrics(package_root: Path = PACKAGE) -> dict:
     """{metric_name: [files emitting it]} across the package source."""
-    names: dict = {}
-    for path in sorted(package_root.rglob("*.py")):
-        for match in EMIT_RE.finditer(path.read_text()):
-            names.setdefault(match.group(1), []).append(
-                str(path.relative_to(REPO))
-            )
-    return names
+    return _rule.emitted_metrics(package_root)
 
 
 def catalog_names(doc_path: Path = CATALOG) -> set:
     """Every backticked snake_case token in the catalog doc."""
-    return set(re.findall(r"`([a-z][a-z0-9_]*)`", doc_path.read_text()))
+    return _rule.catalog_names(doc_path)
 
 
 def check(package_root: Path = PACKAGE, doc_path: Path = CATALOG) -> list:
     """Return [(name, files)] for emitted metrics missing from the doc."""
-    catalog = catalog_names(doc_path)
-    return sorted(
-        (name, sorted(set(files)))
-        for name, files in emitted_metrics(package_root).items()
-        if name not in catalog
-    )
+    return _rule.check(package_root, doc_path)
 
 
 def main() -> int:
