@@ -52,6 +52,14 @@ bench-predict:
 bench-gp-sharded:
 	env DMOSOPT_BENCH_ONLY=gp_sharded python bench.py
 
+# the problem-batched multi-tenant core alone (tenants/sec and wall vs
+# tenant count {1, 16, 64} on small zdt1 runs through the driver's
+# tenant_batching path; override counts with DMOSOPT_BENCH_TENANTS).
+# The T=1 cell is the sequential single-tenant wall — the 64-tenant
+# gate is wall_vs_single <= 8 on an idle host
+bench-tenants:
+	env DMOSOPT_BENCH_ONLY=multi_tenant python bench.py
+
 # Warm .jax_bench_cache with the EXACT programs the round-end bench
 # compiles: one full bench pass, JSON line discarded. Run AFTER the last
 # code commit — any change to optimizer state layouts or jitted program

@@ -906,6 +906,84 @@ print("RESULT=" + json.dumps(res))
 """
 
 
+def bench_multi_tenant(tenant_counts=None):
+    """Config 11: problem-batched multi-tenant core (dmosopt_tpu.tenants)
+    — wall and tenants/sec vs tenant count on small zdt1 optimizations.
+
+    Every run goes through the driver with ``tenant_batching=True``: the
+    T=1 cell IS the sequential single-tenant wall (buckets of one route
+    through the unchanged path), so ``wall_vs_single`` at T=64 is the
+    headline concurrency ratio — the sequential loop would be ~64x, the
+    batched core's target is <= 8x (ISSUE 8 acceptance gate). Carries
+    its own backend/loadavg self-identification (per-measurement, on
+    top of the suite-level fields) so contention artifacts like
+    BENCH_r04/r05 are visible per config."""
+    _ensure_jax()
+    import dmosopt_tpu
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+
+    if tenant_counts is None:
+        env = os.environ.get("DMOSOPT_BENCH_TENANTS")
+        tenant_counts = (
+            tuple(int(v) for v in env.split(",")) if env else (1, 16, 64)
+        )
+    dim, pop, ngen, n_epochs = 4, 16, 8, 2
+
+    def run_once(tag, T):
+        params = {
+            "opt_id": tag,
+            "obj_fun": zdt1,
+            "jax_objective": True,
+            "objective_names": ["f1", "f2"],
+            "space": {f"x{i}": [0.0, 1.0] for i in range(dim)},
+            "problem_parameters": {},
+            "n_initial": 3,
+            "n_epochs": n_epochs,
+            "population_size": pop,
+            "num_generations": ngen,
+            "resample_fraction": 0.5,
+            "optimizer_name": "nsga2",
+            "surrogate_method_name": "gpr",
+            "surrogate_method_kwargs": {
+                "n_starts": 2, "n_iter": 40, "seed": 0,
+            },
+            "random_seed": 17,
+            "telemetry": False,
+            "tenant_batching": True,
+        }
+        if T > 1:
+            params["problem_ids"] = set(range(T))
+        t0 = time.time()
+        dmosopt_tpu.run(params, verbose=False)
+        return time.time() - t0
+
+    out = {
+        "problem": f"zdt1 d={dim} pop={pop} gens={ngen} epochs={n_epochs}",
+        "backend": jax.default_backend(),
+        "loadavg": [round(v, 2) for v in os.getloadavg()],
+        "timing": "best-of-2",
+    }
+    walls = {}
+    for T in tenant_counts:
+        best = float("inf")
+        for rep in range(2):
+            best = min(best, run_once(f"mt_{T}_{rep}", T))
+        walls[T] = best
+        out[f"tenants_{T}"] = {
+            "wall_sec": round(best, 3),
+            "tenants_per_sec": round(T / best, 3),
+        }
+    single = walls.get(1)
+    if single:
+        for T in tenant_counts:
+            if T > 1:
+                out[f"tenants_{T}"]["wall_vs_single"] = round(
+                    walls[T] / single, 2
+                )
+    out["loadavg_end"] = [round(v, 2) for v in os.getloadavg()]
+    return {"multi_tenant": out}
+
+
 def bench_gp_sharded(sizes=None, device_counts=None):
     """Config 10: mesh-sharded GP fit wall vs device count
     (models/gp_sharded.py). Each (N, n_devices) cell runs in its own
@@ -1079,6 +1157,7 @@ def child_main():
         "gp_refit": bench_gp_refit,
         "surrogate_predict": bench_surrogate_predict,
         "gp_sharded": bench_gp_sharded,
+        "multi_tenant": bench_multi_tenant,
     }
     only = os.environ.get("DMOSOPT_BENCH_ONLY")
     if only:

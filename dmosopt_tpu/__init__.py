@@ -38,4 +38,8 @@ def __getattr__(name):
         from dmosopt_tpu.strategy import DistOptStrategy
 
         return DistOptStrategy
+    if name in ("OptimizationService", "TenantHandle", "FrontUpdate"):
+        from dmosopt_tpu import service
+
+        return getattr(service, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

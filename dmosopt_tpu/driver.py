@@ -189,9 +189,9 @@ class DistOptimizer:
         metadata=None,
         # execution backend (TPU-specific)
         jax_objective=False, evaluator=None, n_eval_workers=1, mesh=None,
-        pipeline=None,
+        pipeline=None, tenant_batching=False, min_tenant_bucket=2,
         # observability
-        telemetry=None,
+        telemetry=None, stats_per_problem="auto",
         verbose=False,
         **kwargs,
     ) -> None:
@@ -236,6 +236,20 @@ class DistOptimizer:
             (ring_size, jsonl_path, profile_dir, profile_epochs, ...),
             or a ready-made Telemetry instance — see
             docs/observability.md.
+          tenant_batching: route multi-problem epochs through the
+            problem-batched core (dmosopt_tpu.tenants): problems are
+            bucketed by (optimizer, dim, n_obj, popsize, GP config) and
+            each bucket's surrogate fit + inner EA run as ONE compiled
+            program. Buckets smaller than ``min_tenant_bucket``
+            (default 2) — every single-problem run in particular —
+            take the unchanged sequential path, which stays
+            bitwise-pinned. See docs/parallel.md "Multi-tenant batched
+            core".
+          stats_per_problem: ``get_stats`` label-cardinality guard —
+            ``"auto"`` (default) keeps the historical per-problem key
+            prefixes up to 16 problems and aggregates across problems
+            beyond that; True forces the per-problem breakdown at any
+            tenant count; False always aggregates multi-problem runs.
         """
         if random_seed is not None:
             if local_random is not None:
@@ -290,6 +304,14 @@ class DistOptimizer:
         )
         self.save_surrogate_evals_ = save_surrogate_evals
         self.save_optimizer_params_ = save_optimizer_params
+        self.tenant_batching = bool(tenant_batching)
+        self.min_tenant_bucket = max(int(min_tenant_bucket), 1)
+        if stats_per_problem not in ("auto", True, False):
+            raise ValueError(
+                f"stats_per_problem must be 'auto', True, or False; "
+                f"got {stats_per_problem!r}"
+            )
+        self.stats_per_problem = stats_per_problem
         self.pipeline = PipelineConfig.from_spec(pipeline)
         if self.pipeline.on_eval_failure == "skip" and surrogate_method_name is None:
             # no-surrogate mode evaluates each EA generation for real:
@@ -579,6 +601,28 @@ class DistOptimizer:
 
     # -------------------------------------------------------------- stats
 
+    # per-problem stat prefixes are a label-cardinality hazard: at
+    # 64-256 tenants every phase key becomes hundreds of series in the
+    # merged dict (and in the HDF5 stats group). "auto" keeps the
+    # historical per-problem breakdown up to this many problems and
+    # aggregates beyond; stats_per_problem=True/False overrides.
+    _STATS_PER_PROBLEM_LIMIT = 16
+
+    @staticmethod
+    def _collapse_phase_pairs(stats):
+        """Collapse paired `<phase>_start`/`<phase>_end` timestamps into
+        a single `<phase>` duration; other keys pass through."""
+        out = {}
+        for key, value in stats.items():
+            name, _, period = key.rpartition("_")
+            if period == "start":
+                end = stats.get(f"{name}_end")
+                if end is not None:
+                    out[name] = end - value
+            elif period != "end":
+                out[key] = value
+        return out
+
     def get_stats(self):
         """Merged per-problem stats; paired `<phase>_start`/`<phase>_end`
         timestamps collapse into a single `<phase>` duration.
@@ -588,8 +632,35 @@ class DistOptimizer:
         id — problem 0 included: unprefixed, its keys collide with both
         the driver's own entries (e.g. `init_sampling_*`) and the merged
         phase names of the other problems, silently overwriting one with
-        the other."""
+        the other.
+
+        Beyond `stats_per_problem` (see __init__) the per-problem
+        breakdown is replaced by a cross-problem aggregate: each
+        strategy key K becomes `K_mean` (mean over problems reporting
+        it), plus `stats_n_problems` — flat in tenant count."""
         multi = len(self.problem_ids) > 1
+        per_problem = self.stats_per_problem
+        if per_problem == "auto":
+            per_problem = len(self.problem_ids) <= self._STATS_PER_PROBLEM_LIMIT
+        if multi and not per_problem:
+            sums: Dict[str, float] = {}
+            counts: Dict[str, int] = {}
+            n_reporting = 0
+            for pid in self.problem_ids:
+                strategy = self.optimizer_dict.get(pid)
+                if strategy is None:
+                    continue
+                n_reporting += 1
+                for k, v in self._collapse_phase_pairs(strategy.stats).items():
+                    if isinstance(v, (int, float, np.integer, np.floating)):
+                        sums[k] = sums.get(k, 0.0) + float(v)
+                        counts[k] = counts.get(k, 0) + 1
+            out = self._collapse_phase_pairs(self.stats)
+            out.update(
+                (f"{k}_mean", sums[k] / counts[k]) for k in sums
+            )
+            out["stats_n_problems"] = n_reporting
+            return out
         for pid in self.problem_ids:
             strategy = self.optimizer_dict.get(pid)
             if strategy is None:
@@ -598,16 +669,7 @@ class DistOptimizer:
             self.stats.update(
                 (prefix + k, v) for k, v in strategy.stats.items()
             )
-        out = {}
-        for key, value in self.stats.items():
-            name, _, period = key.rpartition("_")
-            if period == "start":
-                end = self.stats.get(f"{name}_end")
-                if end is not None:
-                    out[name] = end - value
-            elif period != "end":
-                out[key] = value
-        return out
+        return self._collapse_phase_pairs(self.stats)
 
     # ----------------------------------------------------- strategy setup
 
@@ -1307,10 +1369,25 @@ class DistOptimizer:
             # resample batch — the one place speculative mode may return
             # at quorum so the surrogate fit below overlaps the stragglers
             self._process_requests(allow_quorum=True)
-            for strat in self.optimizer_dict.values():
+            if self.tenant_batching and len(self.optimizer_dict) > 1:
+                # problem-batched core: bucket-mates advance through one
+                # compiled program; everyone else (and every bucket of
+                # one) takes the sequential initialize_epoch, unchanged
                 if self.dynamic_initial_sampling is not None and self.epoch_count == 0:
-                    self._drain_dynamic_initial_samples(strat)
-                strat.initialize_epoch(epoch)
+                    for strat in self.optimizer_dict.values():
+                        self._drain_dynamic_initial_samples(strat)
+                from dmosopt_tpu.tenants import initialize_epochs_batched
+
+                initialize_epochs_batched(
+                    self.optimizer_dict, epoch,
+                    min_bucket=self.min_tenant_bucket,
+                    telemetry=self.telemetry, logger=self.logger,
+                )
+            else:
+                for strat in self.optimizer_dict.values():
+                    if self.dynamic_initial_sampling is not None and self.epoch_count == 0:
+                        self._drain_dynamic_initial_samples(strat)
+                    strat.initialize_epoch(epoch)
             self.stats["init_sampling_end"] = time.time()
 
             # every problem must finish its own epoch state machine; problems

@@ -87,6 +87,95 @@ def _as_np(x):
     return np.asarray(x)
 
 
+class LazyHostArray:
+    """A device array whose host transfer is deferred until a consumer
+    actually reads values.
+
+    The periodic termination check hands the population to host-side
+    criteria every ``termination_check_interval`` generations — but many
+    criteria never read it (`MaximumGenerationTermination` looks only at
+    ``opt.n_gen``; an HV budget may read just ``opt.y``). Copying both
+    (cap, n) and (cap, d) populations to host on every check paid a full
+    device sync for data nobody consumed. Wrapping them here keeps the
+    check O(1) until a criterion materializes the array via
+    ``np.asarray`` (``__array__``), indexing, or any ndarray attribute.
+
+    ``shape``/``ndim``/``dtype``/``len`` answer from device metadata
+    without a transfer. ``transfer_count`` (class-level) counts actual
+    materializations — pinned by tests/test_moasmo.py so the deferred
+    copy can't silently regress into an eager one.
+    """
+
+    __slots__ = ("_dev", "_np")
+    transfer_count = 0  # class-level accounting, for tests/diagnostics
+
+    def __init__(self, dev):
+        self._dev = dev
+        self._np = None
+
+    def _materialize(self) -> np.ndarray:
+        if self._np is None:
+            LazyHostArray.transfer_count += 1
+            self._np = _as_np(self._dev)
+        return self._np
+
+    # ---- metadata: no transfer
+    @property
+    def shape(self):
+        return tuple(self._dev.shape)
+
+    @property
+    def ndim(self):
+        return len(self._dev.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._dev.dtype)
+
+    def __len__(self):
+        return self._dev.shape[0]
+
+    # ---- value access: transfers once, then serves the cached copy
+    def __array__(self, dtype=None, copy=None):
+        arr = self._materialize()
+        if dtype is not None:
+            arr = arr.astype(dtype, copy=False)
+        return np.array(arr, copy=True) if copy else arr
+
+    def __getitem__(self, item):
+        return self._materialize()[item]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __getattr__(self, name):
+        # anything beyond the metadata fast path (min/mean/astype/...)
+        # delegates to the materialized ndarray
+        return getattr(self._materialize(), name)
+
+
+def _lazy_delegate(op):
+    def fn(self, *args):
+        return getattr(self._materialize(), op)(*args)
+
+    fn.__name__ = op
+    return fn
+
+
+# operator dunders bypass __getattr__ (special-method lookup goes to the
+# type), so a user criterion doing `opt.y * 2.0` or `-opt.y` — which
+# worked on the eager ndarray — needs explicit delegation
+for _op in (
+    "__add__", "__radd__", "__sub__", "__rsub__", "__mul__", "__rmul__",
+    "__truediv__", "__rtruediv__", "__floordiv__", "__rfloordiv__",
+    "__mod__", "__rmod__", "__pow__", "__rpow__", "__matmul__",
+    "__rmatmul__", "__neg__", "__pos__", "__abs__",
+    "__lt__", "__le__", "__gt__", "__ge__", "__eq__", "__ne__",
+):
+    setattr(LazyHostArray, _op, _lazy_delegate(_op))
+del _op
+
+
 def _feasible_subset(c, *arrays):
     """Subset companion arrays to rows where all constraints are positive;
     when no row is feasible, everything passes through unchanged (the
@@ -246,7 +335,12 @@ def _optimize_on_device(
         if termination is None:
             return gen >= num_generations
         pop_x, pop_y = optimizer.get_population_strategy(optimizer.state)
-        opt = OptHistory(gen, n_eval, _as_np(pop_x), _as_np(pop_y), None)
+        # lazy device->host: criteria that never read the population
+        # (generation caps, eval budgets) cost no transfer; the first
+        # criterion that does triggers exactly one copy per array
+        opt = OptHistory(
+            gen, n_eval, LazyHostArray(pop_x), LazyHostArray(pop_y), None
+        )
         return termination.has_terminated(opt)
 
     while not terminated():
@@ -397,6 +491,14 @@ def optimize(
     `yield`ed and the caller sends back real evaluations; otherwise the
     loop never yields — it runs fully on-device and the `EpochResults`
     arrive via StopIteration.
+
+    NOTE: `dmosopt_tpu.tenants._build_plan` mirrors this function's
+    `local_random` draw sequence (loop key -> generate_initial ->
+    initialize_strategy key -> loop-key split) so batched tenants
+    reproduce the sequential per-tenant PRNG streams exactly. Changing
+    the draw order or count here requires the same change there — the
+    batched-vs-sequential bitwise pins in tests/test_tenants.py and
+    tests/test_service.py trip on any desync.
     """
     key = as_key(local_random)
     bounds = np.column_stack((np.asarray(xlb), np.asarray(xub)))

@@ -596,6 +596,57 @@ def fit_gp_shared(
     )
 
 
+# --------------------------------------------- problems-axis (multi-tenant)
+
+
+def fit_gp_problems(
+    keys: jax.Array,  # (P, ...) one PRNG key per problem
+    X: jax.Array,  # (P, N, n) unit-box inputs, bucket-padded to a COMMON N
+    Y: jax.Array,  # (P, N, d) standardized targets, zero on padded rows
+    train_mask: jax.Array,  # (P, N) 1 = real row
+    **common,
+) -> GPFit:
+    """`fit_gp_batch` lifted over a leading *problems* axis: ONE Adam
+    loop (one XLA program) fits every tenant in a bucket.
+
+    Each problem keeps its own restart grid, its own Adam moments, and
+    its own best-iterate tracking — under `vmap` the per-problem
+    trajectories are independent, so each tenant's result is the same
+    math as its standalone `fit_gp_batch` call at the same padding
+    bucket (modulo batched-kernel reduction order). The in-graph
+    convergence stop lifts to "run while ANY problem's chunk still
+    improves": early-converged tenants may take extra best-iterate-
+    tracked steps, which can only improve their winning NMLL.
+
+    `common` forwards `fit_gp_batch`'s static configuration
+    (kernel/n_starts/n_iter/bounds/...); `mesh` is forced off — the
+    problems axis is the batch axis here. Returns a `GPFit` whose every
+    leaf carries a leading (P,) axis; slice per tenant with
+    `tree_map(lambda a: a[i], fit)`.
+    """
+    common = dict(common)
+    common.pop("mesh", None)
+    common.pop("warm_start", None)
+
+    def one(k, x, y, m):
+        return fit_gp_batch(k, x, y, train_mask=m, mesh=None, **common)
+
+    return jax.vmap(one)(keys, X, Y, train_mask)
+
+
+def gp_predict_problems(fit: GPFit, Xq: jax.Array, kernel: str = "matern52"):
+    """`gp_predict` over a problems-stacked `GPFit` (leading (P,) axis on
+    every leaf) and per-problem query batches `Xq` (P, M, n). Returns
+    ((P, M, d), (P, M, d)) — the solve-oracle math per tenant, batched
+    into one program (jax-traceable; the multi-tenant inner EA scans
+    it)."""
+
+    def one(f, xq):
+        return gp_predict(f, xq, kernel=kernel)
+
+    return jax.vmap(one)(fit, Xq)
+
+
 @partial(jax.jit, static_argnames=("kernel",))
 def gp_predict(fit: GPFit, Xq: jax.Array, kernel: str = "matern52"):
     """Batched posterior mean/variance for all d GPs at query points (M, n).
@@ -820,12 +871,18 @@ def _bucket_size(N: int) -> int:
     return max(step, step * -(-N // step))
 
 
-def _pad_to_bucket(X: np.ndarray, Yn: np.ndarray):
+def _pad_to_bucket(X: np.ndarray, Yn: np.ndarray, cap: Optional[int] = None):
     """Pad (X, Y) rows up to `_bucket_size` and return (X_pad, Y_pad, mask).
     Padded x rows sit at the unit-box center (any finite value works: the
-    train mask decouples them exactly — see `_apply_train_mask`)."""
+    train mask decouples them exactly — see `_apply_train_mask`).
+    ``cap`` overrides the per-N bucket size — the multi-tenant fit pads
+    every tenant in a bucket to one common capacity (the max of their
+    individual buckets) so the problems axis stacks."""
     N = X.shape[0]
-    cap = _bucket_size(N)
+    if cap is None:
+        cap = _bucket_size(N)
+    elif cap < N:
+        raise ValueError(f"pad cap {cap} < {N} rows")
     if cap == N:
         return X, Yn, np.ones((N,), dtype=X.dtype)
     pad = cap - N

@@ -306,10 +306,10 @@ class AGEMOEA(MOEA):
 
     # ------------------------------------------------------------ pure fns
 
-    def initialize_state(self, key, x, y, bounds) -> AGEMOEAState:
+    def initialize_state(self, key, x, y, bounds, mask=None) -> AGEMOEAState:
         P = self.capacity
         perm, rank, crowd = environmental_selection(
-            x, y, P, x_keys=self._x_keys(x)
+            x, y, P, x_keys=self._x_keys(x), mask=mask
         )
         keep = perm[:P]
         return AGEMOEAState(

@@ -62,10 +62,16 @@ class MOEA:
     """Base class for multi-objective evolutionary strategies.
 
     Subclasses implement pure functions:
-      initialize_state(key, x, y, bounds) -> state
+      initialize_state(key, x, y, bounds, mask=None) -> state
       generate_strategy(key, state)       -> (x_gen, state)
       update_strategy(state, x_gen, y_gen) -> state
       get_population_strategy(state)      -> (x, y)
+
+    ``mask`` (optional, (N,) bool) marks real rows of x/y when the seed
+    population is padded to a static shape — the multi-tenant batched
+    core stacks tenants with different archive sizes into one bucket, so
+    each tenant's padding rows must be masked out of the initial
+    survival sort exactly like `_pad_to_bucket` masks GP training rows.
     """
 
     def __init__(self, name: str, popsize: int, nInput: int, nOutput: int, **kwargs):
@@ -205,7 +211,7 @@ class MOEA:
 
     # ----------------------------------------------------- pure functions
 
-    def initialize_state(self, key, x, y, bounds):
+    def initialize_state(self, key, x, y, bounds, mask=None):
         raise NotImplementedError
 
     def generate_strategy(self, key, state):

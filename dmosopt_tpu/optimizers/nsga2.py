@@ -99,7 +99,7 @@ class NSGA2(MOEA):
 
     # ------------------------------------------------------------ pure fns
 
-    def initialize_state(self, key, x, y, bounds) -> NSGA2State:
+    def initialize_state(self, key, x, y, bounds, mask=None) -> NSGA2State:
         n = self.nInput
         pop = self.capacity
         xs, ys, rank, _, _ = sort_mo(
@@ -107,6 +107,7 @@ class NSGA2(MOEA):
             y,
             x_distance_metrics=self.x_distance_metrics,
             y_distance_metrics=self.y_distance_metrics,
+            mask=mask,
             need=pop,
         )
         f32 = xs.dtype

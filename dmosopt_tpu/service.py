@@ -1,0 +1,456 @@
+"""Ask/tell optimization service over the problem-batched core.
+
+The driver runs a fixed set of problems to completion; the service is
+the "millions of users" surface on top of the same machinery (ROADMAP
+item 1): callers **submit** optimization problems at any time, each
+submission joins a tenant **bucket at the next epoch boundary**, every
+`step()` advances all active tenants by one epoch — bucket-mates
+through ONE compiled program per bucket (`dmosopt_tpu.tenants`) — and
+each tenant's improving non-dominated front **streams back** through
+its handle as epochs complete.
+
+Phase staggering is first-class: tenants submitted at different times
+(or with different epoch budgets) share buckets whenever their shapes
+and configs match, each keeping its own epoch numbering; a tenant whose
+configuration the batched core does not cover simply runs the
+sequential path inside the same service loop.
+
+Evaluation of real-objective batches reuses the async evaluator API
+(`submit_batch`): each step submits EVERY tenant's pending requests
+before folding any of them, so jax-objective device batches and
+host-objective thread pools overlap across tenants. Per-tenant
+persistence rides the pipeline's ordered `BackgroundWriter`
+(`storage.save_front_to_h5` per epoch).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dmosopt_tpu.datatypes import OptProblem, ParameterSpace
+from dmosopt_tpu.driver import eval_obj_fun_sp
+from dmosopt_tpu.parallel.evaluator import (
+    EvalFailure,
+    HostFunEvaluator,
+    JaxBatchEvaluator,
+)
+from dmosopt_tpu.parallel.pipeline import BackgroundWriter
+from dmosopt_tpu.strategy import DistOptStrategy
+from dmosopt_tpu.telemetry import Telemetry, create_telemetry
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class FrontUpdate:
+    """One streamed front improvement: the tenant's non-dominated set
+    after `epoch` completed."""
+
+    epoch: int
+    x: np.ndarray
+    y: np.ndarray
+
+
+class TenantHandle:
+    """Caller-facing view of one submitted optimization: stream front
+    updates as they land, read the latest front, await completion."""
+
+    def __init__(self, tenant_id: int, opt_id: str):
+        self.tenant_id = tenant_id
+        self.opt_id = opt_id
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self._updates: deque = deque()
+        self._latest: Optional[FrontUpdate] = None
+        self._lock = threading.Lock()
+
+    # ---- service side
+    def _push(self, update: FrontUpdate):
+        with self._lock:
+            self._updates.append(update)
+            self._latest = update
+
+    # ---- caller side
+    def updates(self) -> List[FrontUpdate]:
+        """Drain the queued front updates (oldest first)."""
+        with self._lock:
+            out = list(self._updates)
+            self._updates.clear()
+        return out
+
+    def best(self) -> Optional[FrontUpdate]:
+        """The most recent front, or None before the first epoch."""
+        with self._lock:
+            return self._latest
+
+    def result(self) -> FrontUpdate:
+        if self.error is not None:
+            raise self.error
+        if not self.done:
+            raise RuntimeError(
+                f"tenant {self.opt_id!r} still running; call "
+                f"OptimizationService.run() or step() first"
+            )
+        if self._latest is None:
+            raise RuntimeError(
+                f"tenant {self.opt_id!r} finished without completing an "
+                f"epoch (no front was produced)"
+            )
+        return self._latest
+
+
+@dataclass
+class _Tenant:
+    handle: TenantHandle
+    strat: DistOptStrategy
+    evaluator: Any
+    owns_evaluator: bool
+    n_epochs: int
+    file_path: Optional[str]
+    param_names: Tuple[str, ...]
+    objective_names: Tuple[str, ...]
+    epochs_run: int = 0
+
+
+class OptimizationService:
+    """Multi-tenant ask/tell optimization: submit problems any time,
+    `step()` advances every active tenant one epoch (bucket-batched),
+    fronts stream back per tenant. Not thread-safe for concurrent
+    `step()` calls; `submit()` may be called from any thread."""
+
+    def __init__(
+        self,
+        *,
+        min_bucket: int = 2,
+        telemetry=None,
+        logger=logger,
+    ):
+        self.min_bucket = int(min_bucket)
+        self.telemetry = create_telemetry(telemetry)
+        self._owns_telemetry = not isinstance(telemetry, Telemetry)
+        self.logger = logger
+        self._pending: List[_Tenant] = []
+        self._active: Dict[int, _Tenant] = {}
+        self._ids = itertools.count()
+        self._writer: Optional[BackgroundWriter] = None
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------ submit
+
+    def submit(
+        self,
+        obj_fun,
+        space: Dict[str, Any],
+        objective_names,
+        *,
+        opt_id: Optional[str] = None,
+        jax_objective: bool = True,
+        n_epochs: int = 5,
+        population_size: int = 64,
+        num_generations: int = 50,
+        n_initial: int = 8,
+        initial_method: str = "slh",
+        resample_fraction: float = 0.25,
+        optimizer_name: str = "nsga2",
+        optimizer_kwargs: Optional[Dict] = None,
+        surrogate_method_name: str = "gpr",
+        surrogate_method_kwargs: Optional[Dict] = None,
+        random_seed: Optional[int] = None,
+        file_path: Optional[str] = None,
+        evaluator=None,
+    ) -> TenantHandle:
+        """Submit one optimization problem; it joins a bucket at the
+        next epoch boundary (`step()`). ``obj_fun`` is a jax-traceable
+        batch objective (``jax_objective=True``, evaluated through the
+        jitted batch evaluator) or a per-point host function. Returns a
+        `TenantHandle` streaming the tenant's fronts."""
+        if self._closed:
+            raise RuntimeError("service is closed")
+        if surrogate_method_name is None:
+            raise ValueError(
+                "the service runs surrogate-mode epochs; "
+                "surrogate_method_name=None is not supported"
+            )
+        tenant_id = next(self._ids)
+        opt_id = opt_id or f"tenant_{tenant_id}"
+        handle = TenantHandle(tenant_id, opt_id)
+
+        param_space = ParameterSpace.from_dict(space)
+        eval_fun = partial(
+            eval_obj_fun_sp, obj_fun, None, param_space, False, None, 0
+        )
+        prob = OptProblem(
+            param_space.parameter_names, list(objective_names), None,
+            lambda f: f, None, param_space, eval_fun, logger=self.logger,
+        )
+        owns_evaluator = evaluator is None
+        if evaluator is None:
+            evaluator = (
+                JaxBatchEvaluator(obj_fun, problem_ids=[0])
+                if jax_objective
+                else HostFunEvaluator(eval_fun)
+            )
+        strat = DistOptStrategy(
+            prob,
+            n_initial=n_initial,
+            initial_method=initial_method,
+            population_size=int(population_size),
+            num_generations=int(num_generations),
+            resample_fraction=float(resample_fraction),
+            optimizer_name=optimizer_name,
+            optimizer_kwargs=optimizer_kwargs,
+            surrogate_method_name=surrogate_method_name,
+            surrogate_method_kwargs=surrogate_method_kwargs,
+            local_random=np.random.default_rng(random_seed),
+            logger=self.logger,
+            telemetry=None,  # per-bucket service telemetry only
+        )
+        tenant = _Tenant(
+            handle=handle, strat=strat, evaluator=evaluator,
+            owns_evaluator=owns_evaluator, n_epochs=int(n_epochs),
+            file_path=file_path,
+            param_names=tuple(param_space.parameter_names),
+            objective_names=tuple(objective_names),
+        )
+        with self._lock:
+            self._pending.append(tenant)
+        if self.telemetry:
+            self.telemetry.inc("tenants_submitted_total")
+        return handle
+
+    # -------------------------------------------------------------- step
+
+    def _admit_pending(self):
+        with self._lock:
+            admitted, self._pending = self._pending, []
+        for t in admitted:
+            self._active[t.handle.tenant_id] = t
+        return len(admitted)
+
+    def _gather_tenant_rounds(self, tenant: _Tenant):
+        """Pop the tenant's pending requests into single-problem
+        evaluation rounds (the driver's `_gather_rounds` for one pid)."""
+        task_args, task_reqs = [], []
+        while True:
+            req = tenant.strat.get_next_request()
+            if req is None:
+                break
+            task_args.append({0: req.parameters})
+            task_reqs.append(req)
+        return task_args, task_reqs
+
+    def _drain_evaluations(self):
+        """Evaluate every tenant's pending requests: submit ALL batches
+        asynchronously first (device batches and host pools overlap
+        across tenants), then fold each tenant's results in submission
+        order."""
+        inflight = []
+        for t in self._active.values():
+            task_args, task_reqs = self._gather_tenant_rounds(t)
+            if not task_args:
+                continue
+            if hasattr(t.evaluator, "submit_batch"):
+                handle = t.evaluator.submit_batch(task_args)
+            else:
+                handle = None
+            inflight.append((t, handle, task_args, task_reqs))
+
+        n_evals = 0
+        for t, handle, task_args, task_reqs in inflight:
+            try:
+                if handle is None:
+                    results = list(t.evaluator.evaluate_batch(task_args))
+                else:
+                    buffered = {}
+                    while not handle.done:
+                        item = handle.poll(timeout=1.0)
+                        if item is None:
+                            continue
+                        buffered[item[0]] = item[1]
+                    results = [buffered[i] for i in sorted(buffered)]
+                for res, req in zip(results, task_reqs):
+                    if isinstance(res, EvalFailure):
+                        raise RuntimeError(
+                            f"tenant {t.handle.opt_id!r}: evaluation "
+                            f"failed after {res.n_attempts} attempt(s)"
+                        ) from res.error
+                    wall = (
+                        res.pop("time", -1.0) if isinstance(res, dict)
+                        else -1.0
+                    )
+                    t.strat.complete_request(
+                        req.parameters, np.asarray(res[0]),
+                        epoch=req.epoch, pred=req.prediction, time=wall,
+                    )
+                    n_evals += 1
+            except Exception as e:
+                # per-tenant failure isolation: a broken objective takes
+                # ITS tenant out (handle.error carries the cause), never
+                # the service or its bucket-mates
+                self._fail_tenant(t, e)
+        return n_evals
+
+    def _fail_tenant(self, tenant: _Tenant, error: BaseException):
+        tenant.handle.error = error
+        tenant.handle.done = True
+        self._active.pop(tenant.handle.tenant_id, None)
+        if tenant.owns_evaluator and hasattr(tenant.evaluator, "close"):
+            try:
+                tenant.evaluator.close()
+            except Exception:
+                self.logger.exception(
+                    f"tenant {tenant.handle.opt_id!r}: evaluator close "
+                    f"failed during failure teardown"
+                )
+        self.logger.warning(
+            f"tenant {tenant.handle.opt_id!r} failed and was retired "
+            f"({type(error).__name__}: {error}); "
+            f"{len(self._active)} tenant(s) continue"
+        )
+        if self.telemetry:
+            self.telemetry.inc("tenants_failed_total")
+
+    def _submit_write(self, fn, *args, **kwargs):
+        if self._writer is None:
+            self._writer = BackgroundWriter(telemetry=self.telemetry)
+        self._writer.submit(fn, *args, **kwargs)
+
+    def _stream_front(self, tenant: _Tenant, epoch: int):
+        bx, by, _, _ = tenant.strat.get_best_evals()
+        if bx is None:
+            return
+        tenant.handle._push(FrontUpdate(epoch, bx, by))
+        if self.telemetry:
+            self.telemetry.inc("tenant_front_updates_total")
+        if tenant.file_path is not None:
+            from dmosopt_tpu.storage import save_front_to_h5
+
+            self._submit_write(
+                save_front_to_h5,
+                tenant.handle.opt_id, epoch, tenant.param_names,
+                tenant.objective_names, bx, by, tenant.file_path,
+                self.logger,
+            )
+
+    def step(self) -> int:
+        """One epoch boundary: admit pending tenants, evaluate pending
+        requests (initial designs and resample batches), advance every
+        active tenant one epoch — bucket-mates batched — and stream
+        fronts. Returns the number of tenants advanced."""
+        if self._closed:
+            raise RuntimeError("service is closed")
+        from dmosopt_tpu.tenants import initialize_epochs_batched
+        from dmosopt_tpu.datatypes import StrategyState
+
+        t0 = time.perf_counter()
+        self._admit_pending()
+        if not self._active:
+            return 0
+        self._drain_evaluations()
+
+        strategies = {
+            tid: t.strat for tid, t in self._active.items()
+        }
+        epochs = {tid: t.epochs_run for tid, t in self._active.items()}
+        initialize_epochs_batched(
+            strategies, epochs, min_bucket=self.min_bucket,
+            telemetry=self.telemetry, logger=self.logger,
+        )
+
+        finished = []
+        for tid, t in list(self._active.items()):
+            try:
+                resample = (t.epochs_run + 1) < t.n_epochs
+                state, _res, _evals = t.strat.update_epoch(resample=resample)
+                if state != StrategyState.CompletedEpoch:
+                    raise RuntimeError(
+                        f"tenant {t.handle.opt_id!r}: epoch did not "
+                        f"complete in one update (state {state}); the "
+                        f"service requires surrogate-mode tenants"
+                    )
+                epoch = t.epochs_run
+                t.epochs_run += 1
+                self._stream_front(t, epoch)
+            except Exception as e:
+                self._fail_tenant(t, e)
+                continue
+            if t.epochs_run >= t.n_epochs:
+                finished.append(tid)
+
+        for tid in finished:
+            t = self._active.pop(tid)
+            t.handle.done = True
+            if t.owns_evaluator and hasattr(t.evaluator, "close"):
+                t.evaluator.close()
+            if self.telemetry:
+                self.telemetry.inc("tenants_completed_total")
+        if self._writer is not None:
+            self._writer.flush()
+        if self.telemetry:
+            self.telemetry.inc("service_epochs_total")
+            self.telemetry.gauge("tenants_active", len(self._active))
+            self.telemetry.observe(
+                "phase_duration_seconds",
+                time.perf_counter() - t0,
+                phase="service_step",
+            )
+        return len(strategies)
+
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Step until every submitted tenant completes (or `max_steps`);
+        returns the number of steps taken."""
+        steps = 0
+        while (self._active or self._pending) and (
+            max_steps is None or steps < max_steps
+        ):
+            self.step()
+            steps += 1
+        return steps
+
+    # ------------------------------------------------------------- close
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for t in list(self._active.values()) + list(self._pending):
+            t.handle.done = True
+            if t.epochs_run < t.n_epochs and t.handle.error is None:
+                # an interim (or absent) front must not read as a
+                # completed optimization: result() re-raises this, while
+                # best()/updates() still serve whatever was streamed
+                t.handle.error = RuntimeError(
+                    f"service closed before tenant {t.handle.opt_id!r} "
+                    f"completed ({t.epochs_run}/{t.n_epochs} epochs)"
+                )
+            if t.owns_evaluator and hasattr(t.evaluator, "close"):
+                try:
+                    t.evaluator.close()
+                except Exception:
+                    self.logger.exception(
+                        f"tenant {t.handle.opt_id!r}: evaluator close failed"
+                    )
+        self._active.clear()
+        self._pending = []
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self.telemetry is not None and self._owns_telemetry:
+            self.telemetry.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -427,6 +427,50 @@ def load_refit_state_from_h5(fpath, opt_id, problem_id) -> Optional[Dict]:
         return _load_json_attr(h5[key], "surrogate_refit")
 
 
+def save_front_to_h5(
+    opt_id, epoch, param_names, objective_names, x, y, fpath, logger=None
+):
+    """Persist one tenant's per-epoch non-dominated front — the
+    streaming artifact of the ask/tell service (dmosopt_tpu.service):
+    `/{opt_id}/fronts/{epoch}/x|y` plus column-name attrs. Latest epoch
+    wins on re-write (a resumed tenant re-streams its current front)."""
+    h5py = _require_h5py()
+    x = np.asarray(x, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32)
+    with h5py.File(fpath, "a") as h5:
+        grp = h5_get_group(h5, f"{opt_id}/fronts/{int(epoch)}")
+        for name, arr in (("x", x), ("y", y)):
+            if name in grp:
+                del grp[name]
+            grp.create_dataset(name, data=arr)
+        grp.attrs["param_names"] = json.dumps(
+            list(param_names), default=json_default
+        )
+        grp.attrs["objective_names"] = json.dumps(
+            list(objective_names), default=json_default
+        )
+    if logger is not None:
+        logger.info(
+            f"save_front_to_h5: {opt_id} epoch {epoch}: "
+            f"{x.shape[0]} front points"
+        )
+
+
+def load_fronts_from_h5(fpath, opt_id):
+    """Read back every epoch front `save_front_to_h5` stored for
+    `opt_id`, as {epoch: (x, y)} ascending by epoch."""
+    h5py = _require_h5py()
+    out = {}
+    with h5py.File(fpath, "r") as h5:
+        grp = h5.get(f"{opt_id}/fronts")
+        if grp is None:
+            return out
+        for name in grp:
+            g = grp[name]
+            out[int(name)] = (np.asarray(g["x"][:]), np.asarray(g["y"][:]))
+    return dict(sorted(out.items()))
+
+
 def save_stats_to_h5(opt_id, problem_id, epoch, fpath, logger=None, stats=None):
     """Store runtime stats per epoch (reference: dmosopt/dmosopt.py:2243-2282)."""
     h5py = _require_h5py()

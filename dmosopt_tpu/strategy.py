@@ -412,6 +412,21 @@ class DistOptStrategy:
         for row in x_gen:
             self.append_request(EvalRequest(row, None, self.epoch_index))
 
+    def install_epoch_result(self, epoch_index: int, result: dict):
+        """Accept an externally computed epoch result — the multi-tenant
+        batched core (dmosopt_tpu.tenants) advances whole buckets of
+        strategies through one compiled program and installs each
+        tenant's surrogate-mode result dict here. The stashed dict takes
+        the same `update_epoch` path as an on-device epoch completed by
+        `initialize_epoch` (see the `isinstance(self.opt_gen, dict)`
+        branch), so resample enqueueing, stats, and persistence are
+        byte-for-byte the sequential flow."""
+        if self.opt_gen is not None:
+            raise RuntimeError("an epoch is already active for this strategy")
+        assert epoch_index > self.epoch_index, (epoch_index, self.epoch_index)
+        self.epoch_index = epoch_index
+        self.opt_gen = result
+
     def _complete_from_result(self, res, resample: bool):
         """Convert the epoch generator's terminal result dict into
         (CompletedEpoch, EpochResults); surrogate-mode results also enqueue

@@ -53,9 +53,13 @@ class Telemetry:
         profile_dir: Optional[str] = None,
         profile_epochs: Optional[Sequence[int]] = None,
         histogram_buckets: Optional[Dict[str, Sequence[float]]] = None,
+        label_series_limit: Optional[int] = 512,
     ):
         self.enabled = bool(enabled)
-        self.registry = MetricsRegistry(histogram_buckets=histogram_buckets)
+        self.registry = MetricsRegistry(
+            histogram_buckets=histogram_buckets,
+            series_limit=label_series_limit,
+        )
         self.log = EventLog(
             ring_size=ring_size,
             jsonl_path=jsonl_path if self.enabled else None,

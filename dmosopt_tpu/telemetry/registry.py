@@ -83,29 +83,59 @@ class MetricsRegistry:
     worker threads.
     """
 
-    def __init__(self, histogram_buckets: Optional[Dict[str, Sequence[float]]] = None):
+    # collapsed label set served once a metric exceeds the series limit
+    _OVERFLOW_LABELS = (("overflow", "true"),)
+
+    def __init__(
+        self,
+        histogram_buckets: Optional[Dict[str, Sequence[float]]] = None,
+        series_limit: Optional[int] = 512,
+    ):
         self._counters: Dict[Tuple, float] = {}
         self._gauges: Dict[Tuple, float] = {}
         self._histograms: Dict[Tuple, _Histogram] = {}
         self._buckets_by_name = dict(histogram_buckets or {})
         self._lock = threading.Lock()
+        # label-cardinality guard: at most `series_limit` distinct label
+        # combinations per metric name; later combinations collapse into
+        # one {overflow="true"} series and are counted by the
+        # `telemetry_series_overflow_total` counter. Per-tenant label
+        # values at 64-256 tenants are exactly the explosion this
+        # bounds; None disables the guard.
+        self._series_limit = series_limit
+        self._series_count: Dict[str, int] = {}
 
     # ------------------------------------------------------------ mutators
+
+    def _guarded_key(self, store: Dict, name: str, labels: Dict) -> Tuple:
+        """Series key for (name, labels), applying the cardinality
+        guard. Caller must hold the lock."""
+        key = (name, _label_key(labels))
+        if self._series_limit is None or not labels or key in store:
+            return key
+        n = self._series_count.get(name, 0)
+        if n >= self._series_limit:
+            okey = ("telemetry_series_overflow_total", ())
+            self._counters[okey] = self._counters.get(okey, 0.0) + 1.0
+            return (name, self._OVERFLOW_LABELS)
+        self._series_count[name] = n + 1
+        return key
 
     def counter_inc(self, name: str, value: float = 1.0, **labels):
         if value < 0:
             raise ValueError(f"counter {name!r}: negative increment {value}")
-        key = (name, _label_key(labels))
         with self._lock:
+            key = self._guarded_key(self._counters, name, labels)
             self._counters[key] = self._counters.get(key, 0.0) + float(value)
 
     def gauge_set(self, name: str, value: float, **labels):
         with self._lock:
-            self._gauges[(name, _label_key(labels))] = float(value)
+            key = self._guarded_key(self._gauges, name, labels)
+            self._gauges[key] = float(value)
 
     def histogram_observe(self, name: str, value: float, **labels):
-        key = (name, _label_key(labels))
         with self._lock:
+            key = self._guarded_key(self._histograms, name, labels)
             h = self._histograms.get(key)
             if h is None:
                 h = self._histograms[key] = _Histogram(

@@ -1,0 +1,657 @@
+"""Problem-batched multi-tenant core: one compiled program per bucket.
+
+The driver already multiplexes problems, but host-level: N tenants pay
+N GP fits, N inner-EA scans, N Python epoch loops. This module lifts the
+*problem* axis into the compiled programs themselves (the tensorized-EMO
+thesis of PAPERS.md applied across optimizations, ROADMAP item 1):
+
+- tenants are **bucketed** by (optimizer, dim, n_obj, popsize, GP fit
+  config) — everything that decides compiled shapes and static
+  hyperparameters;
+- each bucket's surrogate fit runs as ONE Adam loop with a leading
+  problems axis (`models.gp.fit_gp_problems`): per-tenant training sets
+  are padded to a common `_bucket_size` capacity with masked rows, the
+  same discipline `_pad_to_bucket` uses within one tenant;
+- each bucket's inner EA runs as ONE `lax.scan` of a `vmap`-ped
+  generate -> surrogate-predict -> update step over stacked optimizer
+  states, with per-tenant PRNG key streams identical to the streams the
+  sequential path would have drawn;
+- tenants whose epoch phases differ (fewer generations left, joined
+  late) coexist in a bucket through **inactive rows**: a per-generation
+  (G, T) active mask freezes a finished tenant's state with `where`
+  while the bucket program keeps its static shape.
+
+Routing discipline (the PR 3/5/6 regime-split rule): buckets smaller
+than ``min_bucket`` (default 2) — in particular every single-tenant run
+— take the UNCHANGED sequential `DistOptStrategy.initialize_epoch`
+path, which stays bitwise-pinned. Tenants whose configuration the
+batched core does not cover (cycled optimizers, termination criteria,
+refit controllers, mean-variance mode, adaptive populations, non-GPR
+surrogates, meshes) fall back the same way, per tenant.
+
+Per-tenant host randomness (``local_random`` draws, ``generate_initial``
+sampling) is consumed in tenant order *before* any bucket runs, so the
+shared generator advances through the identical sequence of draws the
+sequential loop performs — per-tenant key streams match the sequential
+path exactly; only batched-kernel reduction order differs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmosopt_tpu.config import resolve, default_optimizers
+from dmosopt_tpu.models import Model
+from dmosopt_tpu.models.gp import (
+    _bucket_size,
+    _default_rel_jitter,
+    _pad_to_bucket,
+    _prepare_training_data,
+    fit_gp_problems,
+    gp_predict_problems,
+)
+from dmosopt_tpu.moasmo import (
+    LARGE_N_THRESHOLD,
+    _feasible_subset,
+    get_duplicates,
+    remove_duplicates,
+)
+from dmosopt_tpu.ops import crowding_distance
+from dmosopt_tpu.utils.prng import as_key
+
+# Optimizers whose pure-function triple is known scannable AND
+# vmappable over stacked states (static shapes, no host-side state).
+_BATCHABLE_OPTIMIZERS = ("nsga2", "age")
+
+# GPR_Matern kwargs the batched fit understands; anything else routes
+# the tenant to the sequential path rather than silently dropping it.
+_KNOWN_GP_KWARGS = frozenset({
+    "seed", "n_starts", "n_iter", "learning_rate",
+    "length_scale_bounds", "constant_kernel_bounds", "noise_level_bounds",
+    "anisotropic", "nan", "top_k", "rel_jitter",
+    "convergence_tol", "convergence_check_every",
+    "predictor", "dtype", "large_n_threshold",
+})
+
+
+def bucket_label(dim: int, n_obj: int, pop: int) -> str:
+    """Low-cardinality telemetry label for a bucket shape — the
+    per-bucket aggregation axis that replaces per-tenant label values
+    (64-256 tenants would explode every labeled series)."""
+    return f"d{dim}_o{n_obj}_p{pop}"
+
+
+# ------------------------------------------------------------- eligibility
+
+
+def batch_eligibility(strat) -> Optional[str]:
+    """None when `strat` can join a bucket this epoch; otherwise a short
+    reason string (diagnostics + telemetry)."""
+    if strat.x is None:
+        return "empty archive"
+    if len(strat.optimizer_name) != 1:
+        return "cycled optimizers"
+    name = strat.optimizer_name[0]
+    if not isinstance(name, str) or name not in _BATCHABLE_OPTIMIZERS:
+        return f"optimizer {name!r} not batchable"
+    if strat.surrogate_method_name != "gpr":
+        return f"surrogate {strat.surrogate_method_name!r} not batchable"
+    if strat.surrogate_custom_training is not None:
+        return "custom surrogate training"
+    if strat.sensitivity_method_name is not None:
+        return "sensitivity analysis"
+    if strat.feasibility_method_name is not None:
+        return "feasibility model"
+    if strat.optimize_mean_variance:
+        return "mean-variance mode"
+    if strat.termination is not None:
+        return "termination criterion"
+    if getattr(strat, "refit_controller", None) is not None:
+        return "surrogate refit controller"
+    if strat.mesh is not None:
+        return "mesh"
+    if strat.distance_metric is not None:
+        return "distance metric override"
+    if int(strat.num_generations) < 1:
+        return "num_generations < 1"
+    kwargs = strat.surrogate_method_kwargs or {}
+    unknown = sorted(set(kwargs) - _KNOWN_GP_KWARGS)
+    if unknown:
+        return f"surrogate kwargs {unknown} not batchable"
+    if kwargs.get("predictor", "solve") != "solve":
+        return "non-solve predictor"
+    if str(kwargs.get("dtype", "float32")) != "float32":
+        return "non-float32 surrogate dtype"
+    okw = strat.optimizer_kwargs[0] or {}
+    if okw.get("adaptive_population_size"):
+        return "adaptive population size"
+    if "distance_metric" in okw:
+        return "distance metric override"
+    threshold = kwargs.get("large_n_threshold", LARGE_N_THRESHOLD)
+    if threshold and strat.x.shape[0] > threshold:
+        return "archive beyond dense-kernel threshold"
+    return None
+
+
+def _fit_config(strat) -> Dict[str, Any]:
+    """The `fit_gp_batch` static configuration the sequential
+    GPR_Matern constructor would build from this strategy's surrogate
+    kwargs (see models/gp.py GPR_Matern.__init__)."""
+    kw = strat.surrogate_method_kwargs or {}
+    anisotropic = kw.get("anisotropic")
+    if anisotropic is None:
+        anisotropic = False  # GPR_Matern.anisotropic_default
+    rel_jitter = kw.get("rel_jitter")
+    if rel_jitter is None:
+        rel_jitter = _default_rel_jitter(jnp.float32)
+    return dict(
+        lengthscale_bounds=tuple(kw.get("length_scale_bounds", (1e-3, 100.0))),
+        amplitude_bounds=tuple(kw.get("constant_kernel_bounds", (1e-4, 1e3))),
+        noise_bounds=tuple(kw.get("noise_level_bounds", (1e-9, 1e-2))),
+        kernel="matern52",
+        n_starts=int(kw.get("n_starts", 8)),
+        n_iter=int(kw.get("n_iter", 200)),
+        learning_rate=float(kw.get("learning_rate", 0.1)),
+        ard=bool(anisotropic),
+        rel_jitter=rel_jitter,
+        convergence_tol=kw.get("convergence_tol", "auto"),
+        convergence_check_every=kw.get("convergence_check_every"),
+    )
+
+
+def bucket_signature(strat, optimizer_name: str, okw: Dict) -> Tuple:
+    """Hashable key grouping tenants that may share one compiled
+    program: compiled shapes (dim, n_obj, popsize) plus every static
+    hyperparameter baked into the traced step or the fit."""
+    fitcfg = tuple(sorted((k, repr(v)) for k, v in _fit_config(strat).items()))
+    okw_key = tuple(sorted((k, repr(v)) for k, v in (okw or {}).items()))
+    return (
+        optimizer_name, int(strat.prob.dim), int(strat.prob.n_objectives),
+        int(strat.population_size), fitcfg, okw_key,
+    )
+
+
+# ------------------------------------------------------------------ plans
+
+
+@dataclass
+class _TenantPlan:
+    """One tenant's host-side epoch preparation: everything the bucket
+    run needs, with this tenant's share of the shared RNG already
+    consumed (in tenant order, mirroring the sequential path)."""
+
+    pid: Any
+    strat: Any
+    optimizer: Any  # per-tenant optimizer instance (host bookkeeping)
+    n_resample: int
+    num_generations: int
+    # EA seed population: feasible archive rows + generated design
+    x0: np.ndarray  # feasible archive x (float32)
+    y0: np.ndarray  # feasible archive y (float32)
+    x_init: np.ndarray  # generate_initial sample (popsize, n) float32
+    # surrogate training data (tenant bucket padding applied later)
+    X_unit: np.ndarray  # (N_t, n) unit box, float64
+    Yn: np.ndarray  # (N_t, d) standardized, float64
+    y_mean: np.ndarray
+    y_std: np.ndarray
+    xlb32: np.ndarray  # (n,) float32 — predict-time normalization
+    xrg32: np.ndarray
+    bounds: np.ndarray  # (n, 2) float32
+    fit_key: jax.Array
+    init_key: jax.Array  # initialize_state key
+    loop_key: jax.Array  # generation-loop key (pre-split per generation)
+    stats: Dict[str, Any] = field(default_factory=dict)
+
+
+def _build_plan(pid, strat, optimizer_name: str, okw: Dict) -> _TenantPlan:
+    """Host-side per-tenant epoch prep, consuming `strat.local_random`
+    through the SAME sequence of draws `moasmo.epoch` -> `optimize`
+    performs: optimize's loop key, `generate_initial`'s numpy draws,
+    `initialize_strategy`'s key — so per-tenant device key streams are
+    identical to the sequential path's."""
+    prob = strat.prob
+    pop = int(strat.population_size)
+    stats: Dict[str, Any] = {"model_init_start": time.time()}
+
+    # --- training data (moasmo.train: feasible subset, dedupe, prep)
+    x = np.asarray(strat.x).copy()
+    y = np.asarray(strat.y).copy()
+    _, (x, y) = _feasible_subset(strat.c, x, y)
+    x, y = remove_duplicates(x, y)
+    kw = strat.surrogate_method_kwargs or {}
+    holder = SimpleNamespace()
+    X_unit, Yn, y_mean, y_std = _prepare_training_data(
+        holder, x, y, prob.dim, prob.n_objectives, prob.lb, prob.ub,
+        kw.get("nan", "remove"), kw.get("top_k"),
+    )
+    fit_key = as_key(kw.get("seed"))
+
+    # --- EA seed (moasmo.epoch lines: x_0/y_0 feasible subset)
+    x0 = np.asarray(strat.x, dtype=np.float32).copy()
+    y0 = np.asarray(strat.y, dtype=np.float32).copy()
+    _, (x0, y0) = _feasible_subset(strat.c, x0, y0)
+
+    # --- optimizer instance (moasmo.epoch's constructor spec)
+    okw_merged: Dict[str, Any] = {
+        "sampling_method": "slh", "mutation_rate": None, "nchildren": 1,
+    }
+    okw_merged.update(okw or {})
+    optimizer_cls = resolve(optimizer_name, default_optimizers)
+    mdl = Model(return_mean_variance=False)
+    optimizer = optimizer_cls(
+        nInput=prob.dim, nOutput=prob.n_objectives, popsize=pop,
+        model=mdl, distance_metric=None, optimize_mean_variance=False,
+        **okw_merged,
+    )
+
+    # --- shared-RNG draws, in the sequential path's exact order
+    bounds = np.column_stack(
+        (np.asarray(prob.lb), np.asarray(prob.ub))
+    )
+    key_opt = as_key(strat.local_random)  # optimize(): loop key
+    x_init = np.asarray(
+        optimizer.generate_initial(bounds, strat.local_random),
+        dtype=np.float32,
+    )
+    key_strat = as_key(strat.local_random)  # initialize_strategy's key
+    optimizer.key, init_key = jax.random.split(key_strat)
+    optimizer.bounds = jnp.asarray(bounds, dtype=jnp.float32)
+    _, loop_key = jax.random.split(key_opt)
+
+    stats["model_init_end"] = time.time()
+    return _TenantPlan(
+        pid=pid, strat=strat, optimizer=optimizer,
+        n_resample=int(pop * strat.resample_fraction),
+        num_generations=int(strat.num_generations),
+        x0=x0, y0=y0, x_init=x_init,
+        X_unit=X_unit, Yn=Yn, y_mean=y_mean, y_std=y_std,
+        xlb32=np.asarray(holder.xlb, np.float32),
+        xrg32=np.asarray(holder.xrg, np.float32),
+        bounds=np.asarray(bounds, np.float32),
+        fit_key=fit_key, init_key=init_key, loop_key=loop_key,
+        stats=stats,
+    )
+
+
+# ------------------------------------------------------------- bucket run
+
+# Sub-chunk width of one bucket's surrogate fit. Independent per-problem
+# Adam trajectories mean any split along the problems axis is
+# result-identical (each tenant's fit equals its standalone
+# `fit_gp_batch` either way); splitting lets chunks execute
+# CONCURRENTLY from host threads — the CPU backend runs a batched
+# Cholesky's batch dimension serially inside one execution, so one
+# (64, ...) program is no faster than 64 sequential fits there, while 8
+# threaded (8, ...) executions overlap across cores (measured 11x at
+# T=64). On an accelerator the chunks pipeline through the device queue
+# — same results, no penalty.
+FIT_CHUNK = 8
+
+
+def _fit_bucket(keys, Xs, Yns, masks, fitcfg):
+    """One bucket's surrogate fit across the problems axis, dispatched
+    as FIT_CHUNK-wide `fit_gp_problems` calls from a thread pool and
+    re-concatenated. T <= FIT_CHUNK stays a single call."""
+    T = int(Xs.shape[0])
+    if T <= FIT_CHUNK:
+        return fit_gp_problems(keys, Xs, Yns, masks, **fitcfg)
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    spans = [(i, min(i + FIT_CHUNK, T)) for i in range(0, T, FIT_CHUNK)]
+
+    def one(span):
+        i, j = span
+        return fit_gp_problems(
+            keys[i:j], Xs[i:j], Yns[i:j], masks[i:j], **fitcfg
+        )
+
+    n_workers = min(len(spans), max(os.cpu_count() or 1, 1))
+    with ThreadPoolExecutor(max_workers=n_workers) as pool:
+        parts = list(pool.map(one, spans))
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.concatenate(leaves, axis=0), *parts
+    )
+
+
+def _stack_tree(trees):
+    """Stack a list of identically-shaped pytrees along a new leading
+    (tenants) axis."""
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *trees)
+
+
+def _slice_tree(tree, i):
+    return jax.tree_util.tree_map(lambda a: a[i], tree)
+
+
+# One compiled generation-loop program per (bucket signature, tenant
+# count), reused across epochs and runs: the fit, normalization
+# constants, states, keys and active mask are all ARGUMENTS, so the
+# closure carries only the bucket's static configuration (the tracer
+# optimizer and kernel name). Rebuilding the jit per epoch — the
+# sequential path's per-optimize() cost — re-paid a multi-second
+# trace+compile per bucket per epoch at T=64. FIFO-bounded: a
+# long-lived service whose bucket populations fluctuate (a new (sig, T)
+# per join/finish) must not pin compiled programs forever.
+_PROGRAM_CACHE: Dict[Tuple, Any] = {}
+_PROGRAM_CACHE_MAX = 64
+
+
+def _bucket_program(sig: Tuple, optimizer, kernel: str, T: int):
+    key = (sig, T)
+    prog = _PROGRAM_CACHE.get(key)
+    if prog is not None:
+        return prog
+    while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+        _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+
+    @jax.jit
+    def run_chunk(fit, xlb, xrg, states, keys, active):  # graftlint: disable=retrace-hazard -- cached in _PROGRAM_CACHE keyed by (bucket signature, T); the closure holds only static bucket config, all per-epoch state is arguments
+        def batched_eval(x):  # (T, B, n) -> (T, B, d) surrogate means
+            xq = (x - xlb[:, None, :]) / xrg[:, None, :]
+            mean, _ = gp_predict_problems(fit, xq, kernel=kernel)
+            return mean
+
+        def gen_one(k, s):
+            x_gen, s = optimizer.generate_strategy(k, s)
+            return jnp.clip(x_gen, s.bounds[:, 0], s.bounds[:, 1]), s
+
+        def step(states, inp):
+            keys_t, act = inp
+            x_gen, new_states = jax.vmap(gen_one)(keys_t, states)
+            y_gen = batched_eval(x_gen)
+            new_states = jax.vmap(optimizer.update_strategy)(
+                new_states, x_gen, y_gen
+            )
+            # inactive rows: tenants past their generation budget keep
+            # their state frozen while the program keeps its shape
+            states = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(
+                    act.reshape((T,) + (1,) * (new.ndim - 1)), new, old
+                ),
+                new_states, states,
+            )
+            return states, (x_gen, y_gen)
+
+        return jax.lax.scan(step, states, (keys, active))
+
+    _PROGRAM_CACHE[key] = run_chunk
+    return run_chunk
+
+
+def run_bucket_epoch(
+    plans: List[_TenantPlan], sig: Tuple = (), telemetry=None, logger=None
+):
+    """Advance every tenant in one bucket by one epoch: one batched GP
+    fit, one scanned+vmapped inner-EA program (compiled once per
+    (bucket signature, tenant count), reused across epochs), then
+    per-tenant host-side resample selection. Returns {pid: result dict}
+    with exactly the surrogate-mode `moasmo.epoch` result shape."""
+    T = len(plans)
+    d = plans[0].Yn.shape[1]
+    n = plans[0].X_unit.shape[1]
+    pop = int(plans[0].optimizer.popsize)
+    fitcfg = _fit_config(plans[0].strat)
+    G_max = max(p.num_generations for p in plans)
+
+    # ---- batched surrogate fit: common bucket capacity, masked rows
+    t_fit0 = time.perf_counter()
+    cap = max(_bucket_size(p.X_unit.shape[0]) for p in plans)
+    Xs, Yns, masks = [], [], []
+    for p in plans:
+        Xp, Yp, m = _pad_to_bucket(p.X_unit, p.Yn, cap=cap)
+        Xs.append(jnp.asarray(Xp, jnp.float32))
+        Yns.append(jnp.asarray(Yp, jnp.float32))
+        masks.append(jnp.asarray(m, jnp.float32))
+    keys = jnp.stack([p.fit_key for p in plans])
+    Xs, Yns, masks = jnp.stack(Xs), jnp.stack(Yns), jnp.stack(masks)
+    fit = _fit_bucket(keys, Xs, Yns, masks, fitcfg)
+    fit = fit._replace(
+        y_mean=jnp.asarray(np.stack([p.y_mean for p in plans]), jnp.float32),
+        y_std=jnp.asarray(np.stack([p.y_std for p in plans]), jnp.float32),
+    )
+    jax.block_until_ready(fit.nmll)
+    fit_wall = time.perf_counter() - t_fit0
+    # per-tenant fit summaries, the `stats["objective"]` entry the
+    # sequential epoch records via mdl.get_stats() (see _gp_fit_info)
+    nmll_all = np.asarray(fit.nmll, dtype=np.float64)
+    steps_all = (
+        np.asarray(fit.n_steps) if fit.n_steps is not None else None
+    )
+    n_iter_max = int(fitcfg["n_iter"])
+    for t, p in enumerate(plans):
+        n_steps = (
+            int(steps_all[t]) if steps_all is not None else n_iter_max
+        )
+        p.stats["objective"] = {
+            "loss": float(np.mean(nmll_all[t])),
+            "nmll_per_objective": [float(v) for v in nmll_all[t]],
+            "n_steps": n_steps,
+            "n_iter_max": n_iter_max,
+            "early_stopped": n_steps < n_iter_max,
+        }
+
+    # ---- per-tenant normalization constants for predict
+    xlb = jnp.asarray(np.stack([p.xlb32 for p in plans]))  # (T, n)
+    xrg = jnp.asarray(np.stack([p.xrg32 for p in plans]))
+    bounds = jnp.asarray(np.stack([p.bounds for p in plans]))  # (T, n, 2)
+    kernel = fitcfg["kernel"]
+
+    def batched_eval(x):  # (T, B, n) -> (T, B, d) surrogate means
+        xq = (x - xlb[:, None, :]) / xrg[:, None, :]
+        mean, _ = gp_predict_problems(fit, xq, kernel=kernel)
+        return mean
+
+    # ---- initial populations: y for the generated design comes from
+    # the freshly fitted surrogates (one batched predict), then each
+    # tenant's [archive ; design] rows pad to a common masked capacity
+    t_ea0 = time.perf_counter()
+    y_init = np.asarray(
+        batched_eval(jnp.asarray(np.stack([p.x_init for p in plans])))
+    ).astype(np.float32)
+    run_chunk = _bucket_program(sig, plans[0].optimizer, kernel, T)
+    n_cat = [p.x0.shape[0] + p.x_init.shape[0] for p in plans]
+    P_init = max(n_cat)
+    Xcat = np.zeros((T, P_init, n), np.float32)
+    Ycat = np.zeros((T, P_init, d), np.float32)
+    Mcat = np.zeros((T, P_init), bool)
+    for t, p in enumerate(plans):
+        xc = np.vstack([p.x0, p.x_init])
+        yc = np.vstack([p.y0, y_init[t]])
+        Xcat[t, : n_cat[t]] = xc
+        Ycat[t, : n_cat[t]] = yc
+        Mcat[t, : n_cat[t]] = True
+
+    optimizer = plans[0].optimizer  # bucket tracer: same static config
+
+    def init_one(k, x, y, b, m):
+        return optimizer.initialize_state(k, x, y, b, mask=m)
+
+    states = jax.vmap(init_one)(
+        jnp.stack([p.init_key for p in plans]),
+        jnp.asarray(Xcat), jnp.asarray(Ycat), bounds, jnp.asarray(Mcat),
+    )
+
+    # ---- per-tenant generation keys: split(loop_key, G_t) exactly as
+    # the sequential scan would, zero-padded to G_max for late phases
+    keys = np.zeros((T, G_max, 2), np.uint32)
+    active = np.zeros((G_max, T), bool)
+    for t, p in enumerate(plans):
+        kt = jax.random.split(p.loop_key, p.num_generations)
+        keys[t, : p.num_generations] = np.asarray(
+            jax.random.key_data(kt)
+            if jnp.issubdtype(kt.dtype, jax.dtypes.prng_key)
+            else kt
+        )
+        active[: p.num_generations, t] = True
+    keys_scan = jnp.asarray(np.swapaxes(keys, 0, 1))  # (G, T, 2)
+    active_scan = jnp.asarray(active)
+
+    states, (x_traj, y_traj) = run_chunk(
+        fit, xlb, xrg, states, keys_scan, active_scan
+    )
+    x_traj = np.asarray(x_traj)  # (G, T, noff, n)
+    y_traj = np.asarray(y_traj)
+    # one host materialization of the final states; per-tenant slices
+    # below are numpy views, not T x n_leaves device dispatches
+    states = jax.tree_util.tree_map(np.asarray, states)
+    ea_wall = time.perf_counter() - t_ea0
+    noff = x_traj.shape[2]
+
+    # ---- per-tenant host tail: flatten trajectories, dedupe, resample
+    results = {}
+    for t, p in enumerate(plans):
+        G_t = p.num_generations
+        x_dev = x_traj[:G_t, t].reshape(-1, n)
+        y_dev = y_traj[:G_t, t].reshape(-1, d)
+        gen_index = np.concatenate(
+            [np.zeros((n_cat[t],), np.uint32)]
+            + [
+                np.full((noff,), g + 1, dtype=np.uint32)
+                for g in range(G_t)
+            ]
+        )
+        x_all = np.vstack([Xcat[t, : n_cat[t]], x_dev])
+        y_all = np.vstack([Ycat[t, : n_cat[t]], y_dev])
+
+        p.optimizer.state = _slice_tree(states, t)
+        best_x, best_y = (
+            np.asarray(a) for a in p.optimizer.population_objectives
+        )
+        is_duplicate = get_duplicates(best_x, p.x0)
+        best_x = best_x[~is_duplicate]
+        best_y = best_y[~is_duplicate]
+        D = np.asarray(crowding_distance(jnp.asarray(best_y)))
+        idxr = D.argsort()[::-1][: p.n_resample]
+        results[p.pid] = {
+            "x_resample": best_x[idxr, :], "y_pred": best_y[idxr, :],
+            "gen_index": gen_index, "x_sm": x_all, "y_sm": y_all,
+            "optimizer": p.optimizer, "stats": dict(p.stats),
+        }
+
+    if telemetry:
+        label = bucket_label(n, d, pop)
+        telemetry.inc("tenant_bucket_epochs_total", bucket=label)
+        telemetry.inc("tenants_batched_total", T)
+        telemetry.gauge("tenant_bucket_size", T, bucket=label)
+        telemetry.observe("phase_duration_seconds", fit_wall, phase="train")
+        telemetry.observe("phase_duration_seconds", ea_wall, phase="optimize")
+        telemetry.event(
+            "tenant_bucket", bucket=label, n_tenants=T,
+            n_generations=G_max, train_cap=int(cap),
+            fit_s=round(fit_wall, 4), ea_s=round(ea_wall, 4),
+            gens_per_sec=(
+                round(sum(p.num_generations for p in plans) / ea_wall, 3)
+                if ea_wall > 0 else None
+            ),
+        )
+    if logger is not None:
+        logger.info(
+            f"tenant bucket {bucket_label(n, d, pop)}: {T} tenants, "
+            f"fit {fit_wall:.3f}s (cap {cap}), EA {ea_wall:.3f}s "
+            f"({G_max} gens)"
+        )
+    return results
+
+
+# ------------------------------------------------------------ entry point
+
+
+def initialize_epochs_batched(
+    strategies: Dict[Any, Any],
+    epoch,
+    *,
+    min_bucket: int = 2,
+    telemetry=None,
+    logger=None,
+):
+    """Drive every strategy's epoch initialization, batching bucket-mates
+    through one compiled program and routing everyone else through the
+    unchanged sequential `initialize_epoch`.
+
+    ``epoch`` is the epoch index shared by every strategy (the driver's
+    case), or a ``{pid: epoch_index}`` dict when tenants' epoch phases
+    are staggered (the service's case — tenants submitted at different
+    times share buckets while keeping their own epoch numbering).
+
+    Pass 1 (no side effects): eligibility + bucket sizing. Pass 2, in
+    tenant order: sequential tenants run `initialize_epoch` NOW;
+    batched tenants consume their shared-RNG draws NOW (so the global
+    draw order matches the sequential loop) and defer device work.
+    Then each bucket runs and installs its per-tenant results.
+    Returns {pid: "batched" | "sequential"} for tests/diagnostics.
+    """
+    epochs = (
+        epoch if isinstance(epoch, dict)
+        else {pid: epoch for pid in strategies}
+    )
+    # pass 1: eligibility and bucket membership. Folding completed
+    # evaluations first (idempotent — initialize_epoch repeats it as a
+    # no-op) lets epoch 0 see the just-drained initial design instead
+    # of an empty archive; no randomness is consumed here.
+    sigs: Dict[Any, Optional[Tuple]] = {}
+    for pid, strat in strategies.items():
+        strat._update_evals()
+        reason = batch_eligibility(strat)
+        if reason is None:
+            sigs[pid] = bucket_signature(
+                strat, strat.optimizer_name[0], strat.optimizer_kwargs[0]
+            )
+        else:
+            sigs[pid] = None
+            if logger is not None:
+                logger.info(
+                    f"tenant {pid}: sequential path ({reason})"
+                )
+            if telemetry:
+                telemetry.inc("tenants_sequential_total")
+    counts: Dict[Tuple, int] = {}
+    for sig in sigs.values():
+        if sig is not None:
+            counts[sig] = counts.get(sig, 0) + 1
+
+    # pass 2: tenant order — sequential inits and batched RNG draws
+    # interleave exactly as the sequential loop would consume them
+    buckets: Dict[Tuple, List[_TenantPlan]] = {}
+    routing: Dict[Any, str] = {}
+    for pid, strat in strategies.items():
+        sig = sigs[pid]
+        if sig is None or counts[sig] < min_bucket:
+            strat.initialize_epoch(epochs[pid])
+            routing[pid] = "sequential"
+            continue
+        name, okw = strat._cycled_optimizer()
+        buckets.setdefault(sig, []).append(
+            _build_plan(pid, strat, name, okw)
+        )
+        routing[pid] = "batched"
+
+    for sig, plans in buckets.items():
+        try:
+            results = run_bucket_epoch(
+                plans, sig, telemetry=telemetry, logger=logger
+            )
+        except Exception:
+            # robustness over parity on the error path: the shared RNG
+            # already advanced, so trajectories differ from a pure
+            # sequential run, but every tenant still completes
+            if logger is not None:
+                logger.exception(
+                    f"bucket {sig[:4]} batched epoch failed; falling "
+                    f"back to the sequential path for its "
+                    f"{len(plans)} tenant(s)"
+                )
+            for p in plans:
+                p.strat.initialize_epoch(epochs[p.pid])
+                routing[p.pid] = "sequential"
+            continue
+        for p in plans:
+            p.strat.install_epoch_result(epochs[p.pid], results[p.pid])
+    return routing

@@ -739,3 +739,173 @@ def test_lint_metrics_alias_delegates():
     spec.loader.exec_module(mod)
     assert mod.check() == []
     assert len(mod.emitted_metrics()) > 0
+
+
+# --------------------------------------------------- --bump-frozen helper
+
+
+def _write_sandbox_registry(root, reg):
+    lines = ["FROZEN = {"]
+    for name, entry in reg.items():
+        lines.append(f'    "{name}": {{')
+        for k, v in entry.items():
+            lines.append(f'        "{k}": {v!r},')
+        lines.append("    },")
+    lines.append("}")
+    path = root / "frozen_registry.py"
+    path.write_text("\n".join(lines) + "\n")
+    return path
+
+
+def _load_registry_file(path):
+    ns = {}
+    exec(path.read_text(), ns)
+    return ns["FROZEN"]
+
+
+def test_bump_frozen_makes_red_lint_green_again(tmp_path):
+    """The ISSUE-8 loop: mutate a frozen function (lint red), run the
+    bump helper, lint is green against the rewritten registry — with
+    reason/pinned_by text untouched."""
+    from tools.graftlint.bump import bump_frozen
+
+    root = _mkpkg(tmp_path, _FROZEN_SRC)
+    reg_path = _write_sandbox_registry(root, _frozen_registry(root))
+
+    (root / "pkg/a.py").write_text("def frozen_fn(x):\n    return x + 2\n")
+    red = run_lint(
+        root, ("pkg",), rules=["frozen-path-guard"],
+        options={"frozen_registry": _load_registry_file(reg_path)},
+    )
+    assert _live(red, "frozen-path-guard")
+
+    changed = bump_frozen(
+        root, ("pkg",), ["all"], registry_path=reg_path
+    )
+    assert list(changed) == ["pkg.a.frozen_fn"]
+    old, new = changed["pkg.a.frozen_fn"]
+    assert old != new and len(new) == 64
+
+    bumped = _load_registry_file(reg_path)
+    assert bumped["pkg.a.frozen_fn"]["reason"] == "fixture"
+    assert bumped["pkg.a.frozen_fn"]["pinned_by"] == "this test"
+    green = run_lint(
+        root, ("pkg",), rules=["frozen-path-guard"],
+        options={"frozen_registry": bumped},
+    )
+    assert not _live(green)
+
+
+def test_bump_frozen_noop_and_unknown_names(tmp_path):
+    import pytest
+
+    from tools.graftlint.bump import bump_frozen
+
+    root = _mkpkg(tmp_path, _FROZEN_SRC)
+    reg_path = _write_sandbox_registry(root, _frozen_registry(root))
+    before = reg_path.read_text()
+    assert bump_frozen(root, ("pkg",), ["all"], registry_path=reg_path) == {}
+    assert reg_path.read_text() == before  # in-sync bump rewrites nothing
+    with pytest.raises(KeyError, match="not in the frozen registry"):
+        bump_frozen(root, ("pkg",), ["pkg.a.missing"], registry_path=reg_path)
+
+
+def test_bump_frozen_real_registry_is_in_sync(tmp_path):
+    """The shipped registry matches the shipped source: a bump against a
+    COPY of the real registry is a no-op (`make lint` is green and the
+    helper agrees). Catches a drifted hash landing without its bump."""
+    import shutil
+
+    from tools.graftlint.bump import DEFAULT_REGISTRY, bump_frozen
+
+    copy = tmp_path / "frozen_registry.py"
+    shutil.copy(DEFAULT_REGISTRY, copy)
+    changed = bump_frozen(
+        REPO, DEFAULT_TARGETS, ["all"], registry_path=copy
+    )
+    assert changed == {}, f"registry out of sync with source: {changed}"
+
+
+def test_bump_frozen_cli(tmp_path):
+    """CLI surface: --registry-file is honored end to end. The CLI
+    resolves lint targets against the real repo root, so point it at a
+    sandbox registry naming a function absent from those targets — the
+    usage-error exit proves the file was read and the names resolved."""
+    import os
+    import shutil
+    import subprocess
+    import sys as _sys
+
+    root = _mkpkg(tmp_path, _FROZEN_SRC)
+    reg_path = _write_sandbox_registry(root, _frozen_registry(root))
+    shutil.copy(reg_path, reg_path.parent / "copy.py")
+    proc = subprocess.run(
+        [_sys.executable, "-m", "tools.graftlint", "--bump-frozen", "all",
+         "--registry-file", str(reg_path.parent / "copy.py")],
+        capture_output=True, text=True, cwd=str(REPO),
+        env={**os.environ, "PYTHONPATH": str(REPO)},
+    )
+    assert proc.returncode == 2
+    assert "not found in lint targets" in proc.stderr
+
+
+def test_bump_frozen_missing_sha_never_rewrites_neighbor(tmp_path):
+    """An entry missing its sha256 line must error, NOT cross the entry
+    boundary and rewrite the next entry's hash."""
+    import pytest
+
+    from tools.graftlint.bump import bump_frozen
+
+    root = _mkpkg(tmp_path, {"pkg/a.py": """
+        def frozen_fn(x):
+            return x + 1
+
+        def other_fn(x):
+            return x - 1
+    """})
+    ctx = load_context(root, ("pkg",))
+    other_hash = frozen_hash(ctx.functions["pkg.a.other_fn"].node)
+    reg_path = root / "frozen_registry.py"
+    reg_path.write_text(
+        "FROZEN = {\n"
+        '    "pkg.a.frozen_fn": {\n'
+        '        "reason": "no sha line here",\n'
+        "    },\n"
+        '    "pkg.a.other_fn": {\n'
+        f'        "sha256": "{"0" * 64}",\n'
+        '        "reason": "stale on purpose",\n'
+        "    },\n"
+        "}\n"
+    )
+    with pytest.raises(KeyError, match="no sha256 line"):
+        bump_frozen(
+            root, ("pkg",), ["pkg.a.frozen_fn"], registry_path=reg_path
+        )
+    assert "0" * 64 in reg_path.read_text()  # neighbor untouched
+
+    # bumping the neighbor itself still works inside its own block
+    changed = bump_frozen(
+        root, ("pkg",), ["pkg.a.other_fn"], registry_path=reg_path
+    )
+    assert changed["pkg.a.other_fn"] == ("0" * 64, other_hash)
+
+
+def test_bump_frozen_brace_in_reason_string(tmp_path):
+    """Entry spans come from the AST: braces inside reason strings must
+    not skew the boundary (a text-level brace scan truncated the entry
+    at 'fig 3}' and missed its sha256 line)."""
+    from tools.graftlint.bump import bump_frozen
+
+    root = _mkpkg(tmp_path, _FROZEN_SRC)
+    reg = _frozen_registry(root)
+    reg["pkg.a.frozen_fn"]["reason"] = "re-baselined, see fig 3} {open"
+    reg["pkg.a.frozen_fn"] = dict(
+        reason=reg["pkg.a.frozen_fn"]["reason"],
+        sha256=reg["pkg.a.frozen_fn"]["sha256"],  # sha AFTER the reason
+        pinned_by="this test",
+    )
+    reg_path = _write_sandbox_registry(root, reg)
+    assert bump_frozen(root, ("pkg",), ["all"], registry_path=reg_path) == {}
+    (root / "pkg/a.py").write_text("def frozen_fn(x):\n    return x + 9\n")
+    changed = bump_frozen(root, ("pkg",), ["all"], registry_path=reg_path)
+    assert list(changed) == ["pkg.a.frozen_fn"]

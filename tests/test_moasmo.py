@@ -238,3 +238,72 @@ def test_host_loop_escape_hatch_for_non_scannable_optimizer():
     assert len(gen_counts) == 4
     assert x_new.shape == (int(gen_counts.sum()), dim)
     assert np.all(np.isfinite(y_new))
+
+
+def test_lazy_termination_defers_population_transfer():
+    """The periodic termination check must not copy the population to
+    host unless a criterion actually reads it: a generation-cap
+    criterion costs ZERO transfers, a population-reading criterion
+    triggers exactly one materialization per array per check. Pinned by
+    LazyHostArray.transfer_count so the deferred copy can't silently
+    regress into an eager one."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmosopt_tpu.models import Model
+    from dmosopt_tpu.models.gp import GPR_Matern
+    from dmosopt_tpu.moasmo import LazyHostArray
+    from dmosopt_tpu.optimizers.nsga2 import NSGA2
+    from dmosopt_tpu.termination import (
+        MaximumGenerationTermination,
+        MultiObjectiveToleranceTermination,
+    )
+
+    dim, pop = 4, 16
+    rng = np.random.default_rng(0)
+    X = rng.uniform(size=(32, dim)).astype(np.float32)
+    Y = np.asarray(zdt1(jnp.asarray(X)))
+    sm = GPR_Matern(X, Y, dim, 2, np.zeros(dim), np.ones(dim),
+                    seed=0, n_starts=2, n_iter=15)
+    eval_fn = moasmo._surrogate_eval_fn(Model(objective=sm))
+    bounds = np.stack([np.zeros(dim), np.ones(dim)], 1)
+
+    class Prob:
+        lb = np.zeros(dim)
+        ub = np.ones(dim)
+        logger = None
+
+    def run(term):
+        opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=None)
+        opt.initialize_strategy(X[:pop], Y[:pop], bounds, random=0)
+        before = LazyHostArray.transfer_count
+        moasmo._optimize_on_device(
+            opt, eval_fn, num_generations=6, key=jax.random.PRNGKey(0),
+            termination=term, termination_check_interval=2,
+        )
+        return LazyHostArray.transfer_count - before
+
+    # generation cap: n_gen only — the populations stay on device
+    assert run(MaximumGenerationTermination(Prob(), n_max_gen=6)) == 0
+    # objective-tolerance: reads opt.y (never opt.x) — y transfers, x not
+    n = run(MultiObjectiveToleranceTermination(Prob(), n_max_gen=6))
+    assert n >= 1
+    # 4 checks (gens 0,2,4,6): one y materialization each, and no x
+    assert n <= 4
+
+
+def test_lazy_host_array_supports_operators():
+    """Operator dunders bypass __getattr__; a user criterion doing
+    `opt.y * 2.0` or `-opt.y` must keep working as it did on the eager
+    ndarray (materializing on first use)."""
+    import jax.numpy as jnp
+
+    from dmosopt_tpu.moasmo import LazyHostArray
+
+    lazy = LazyHostArray(jnp.asarray([[1.0, 2.0], [3.0, 4.0]]))
+    np.testing.assert_allclose(lazy * 2.0, [[2.0, 4.0], [6.0, 8.0]])
+    np.testing.assert_allclose(2.0 + lazy, [[3.0, 4.0], [5.0, 6.0]])
+    np.testing.assert_allclose(-lazy, [[-1.0, -2.0], [-3.0, -4.0]])
+    assert (lazy > 2.5).sum() == 2
+    np.testing.assert_allclose(lazy / 2.0, [[0.5, 1.0], [1.5, 2.0]])
+    assert lazy.shape == (2, 2) and lazy.ndim == 2

@@ -1,0 +1,201 @@
+"""Ask/tell service over the problem-batched core (dmosopt_tpu.service).
+
+The mixed-bucket contract: tenants with different dims land in
+different buckets, tenants submitted at different times (staggered
+epoch phases) share buckets through masked rows — and every tenant's
+results equal the sequential path's, pinned bitwise in-process.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dmosopt_tpu.benchmarks.zdt import zdt1
+from dmosopt_tpu.service import OptimizationService
+
+SMK = {"n_starts": 2, "n_iter": 30, "seed": 0}
+
+
+def _submit(svc, *, dim, seed, n_epochs=2, num_generations=6, **extra):
+    return svc.submit(
+        zdt1,
+        {f"x{i}": [0.0, 1.0] for i in range(dim)},
+        ["f1", "f2"],
+        n_epochs=n_epochs,
+        population_size=16,
+        num_generations=num_generations,
+        n_initial=3,
+        surrogate_method_kwargs=dict(SMK),
+        random_seed=seed,
+        **extra,
+    )
+
+
+def test_service_staggered_mixed_buckets_match_sequential():
+    """Two dims + a late join with a shorter generation budget: the d6
+    bucket holds tenants at STAGGERED epoch phases (different archive
+    sizes -> masked training rows; different generation budgets ->
+    inactive generation rows), the d4 tenant rides its own route. Every
+    tenant's streamed fronts must be bitwise-equal to a sequential-only
+    service run with the same seeds."""
+
+    def run(min_bucket):
+        svc = OptimizationService(min_bucket=min_bucket, telemetry=True)
+        handles = {}
+        handles["a"] = _submit(svc, dim=6, seed=10, n_epochs=3)
+        handles["b"] = _submit(svc, dim=6, seed=11, n_epochs=3)
+        svc.step()  # a, b complete epoch 0
+        # late joins: d (same bucket shape, SHORTER generation budget,
+        # epoch phase one behind) and c (different dim -> other bucket)
+        handles["d"] = _submit(
+            svc, dim=6, seed=13, n_epochs=2, num_generations=4
+        )
+        handles["c"] = _submit(svc, dim=4, seed=12, n_epochs=2)
+        svc.run()
+        fronts = {
+            k: [(u.epoch, u.x, u.y) for u in h.updates()]
+            for k, h in handles.items()
+        }
+        assert all(h.done for h in handles.values())
+        tel = svc.telemetry
+        svc.close()
+        return fronts, tel
+
+    batched, tel = run(min_bucket=2)
+    sequential, _ = run(min_bucket=99)
+
+    # the d6 bucket really ran batched: 2 tenants at step 1, then 3
+    # (a, b at epoch 1/2 alongside d at epoch 0/1)
+    reg = tel.registry
+    assert reg.counter_value("tenants_batched_total") >= 4.0
+    assert reg.counter_value(
+        "tenant_bucket_epochs_total", bucket="d6_o2_p16"
+    ) >= 2.0
+
+    for k in ("a", "b", "c", "d"):
+        assert [e for e, _, _ in batched[k]] == [
+            e for e, _, _ in sequential[k]
+        ]
+        for (eb, xb, yb), (es, xs, ys) in zip(batched[k], sequential[k]):
+            assert xb.shape == xs.shape and yb.shape == ys.shape, (k, eb)
+            np.testing.assert_array_equal(xb, xs, err_msg=f"{k} epoch {eb}")
+            np.testing.assert_array_equal(yb, ys, err_msg=f"{k} epoch {eb}")
+
+
+def test_service_streams_and_persists(tmp_path):
+    svc = OptimizationService(telemetry=True)
+    h0 = _submit(
+        svc, dim=4, seed=1, file_path=str(tmp_path / "t0.h5"),
+        opt_id="tenant_a",
+    )
+    h1 = _submit(svc, dim=4, seed=2)
+    steps = svc.run()
+    assert steps == 2  # both tenants: 2 epochs each, admitted together
+    for h in (h0, h1):
+        ups = h.updates()
+        assert [u.epoch for u in ups] == [0, 1]
+        assert h.done
+        assert h.result().epoch == 1
+        # a drained handle still serves the latest front
+        assert h.best().epoch == 1
+        assert h.updates() == []
+    from dmosopt_tpu.storage import load_fronts_from_h5
+
+    fronts = load_fronts_from_h5(str(tmp_path / "t0.h5"), "tenant_a")
+    assert sorted(fronts) == [0, 1]
+    for _, (x, y) in fronts.items():
+        assert x.shape[1] == 4 and y.shape[1] == 2
+    reg = svc.telemetry.registry
+    assert reg.counter_value("tenants_submitted_total") == 2.0
+    assert reg.counter_value("tenants_completed_total") == 2.0
+    assert reg.counter_value("tenant_front_updates_total") == 4.0
+    assert reg.gauge_value("tenants_active") == 0.0
+    svc.close()
+
+
+def test_service_host_objective():
+    def host_zdt1(pp):
+        x = np.asarray([pp[f"x{i}"] for i in range(4)], dtype=np.float32)
+        y = np.asarray(zdt1(x[None, :]))[0]
+        return y
+
+    svc = OptimizationService(telemetry=False)
+    h = svc.submit(
+        host_zdt1,
+        {f"x{i}": [0.0, 1.0] for i in range(4)},
+        ["f1", "f2"],
+        jax_objective=False,
+        n_epochs=2, population_size=16, num_generations=4, n_initial=3,
+        surrogate_method_kwargs=dict(SMK), random_seed=3,
+    )
+    svc.run()
+    assert h.done
+    front = h.result()
+    assert front.x.shape[1] == 4 and front.y.shape[1] == 2
+    assert np.all(np.isfinite(front.y))
+    svc.close()
+
+
+def test_service_usage_errors():
+    svc = OptimizationService()
+    h = _submit(svc, dim=4, seed=5)
+    with pytest.raises(RuntimeError, match="still running"):
+        h.result()
+    with pytest.raises(ValueError, match="surrogate"):
+        svc.submit(
+            zdt1, {"x0": [0.0, 1.0]}, ["f1", "f2"],
+            surrogate_method_name=None,
+        )
+    svc.close()
+    assert h.done  # closing finalizes pending tenants
+    with pytest.raises(RuntimeError, match="closed"):
+        _submit(svc, dim=4, seed=6)
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.step()
+
+
+def test_service_failure_isolation():
+    """A broken objective retires ITS tenant (handle.error carries the
+    cause) while bucket-mates run to completion."""
+
+    def broken(X):
+        raise RuntimeError("objective exploded")
+
+    svc = OptimizationService(telemetry=True)
+    bad = svc.submit(
+        broken, {f"x{i}": [0.0, 1.0] for i in range(4)}, ["f1", "f2"],
+        jax_objective=False,  # host path: the exception surfaces per call
+        n_epochs=2, population_size=16, num_generations=4, n_initial=3,
+        surrogate_method_kwargs=dict(SMK), random_seed=7,
+    )
+    good = _submit(svc, dim=4, seed=8)
+    svc.run()
+    assert bad.done and bad.error is not None
+    with pytest.raises(RuntimeError):
+        bad.result()
+    assert good.done and good.error is None
+    assert good.result().epoch == 1
+    reg = svc.telemetry.registry
+    assert reg.counter_value("tenants_failed_total") == 1.0
+    assert reg.counter_value("tenants_completed_total") == 1.0
+    svc.close()
+
+
+def test_service_close_marks_incomplete_tenants_errored():
+    svc = OptimizationService()
+    h = _submit(svc, dim=4, seed=9, n_epochs=3)
+    svc.step()  # one of three epochs
+    partial = h.best()
+    svc.close()
+    assert h.done
+    with pytest.raises(RuntimeError, match="service closed before"):
+        h.result()
+    # the interim front is still readable, just not presented as final
+    assert h.best() is partial and partial.epoch == 0
+
+    svc2 = OptimizationService()
+    h2 = _submit(svc2, dim=4, seed=9)
+    svc2.close()  # never stepped: no front at all
+    with pytest.raises(RuntimeError, match="service closed before"):
+        h2.result()

@@ -453,3 +453,63 @@ def test_disabled_run_makes_zero_telemetry_calls(tmp_path, monkeypatch):
     )
     with h5py.File(fp, "r") as h5:
         assert "telemetry" not in h5["tel_run"]
+
+
+def test_registry_label_series_limit_collapses_overflow():
+    """Label-cardinality guard: past `series_limit` distinct label sets
+    per metric name, emissions collapse into one overflow="true" series
+    (totals preserved) and are counted by
+    telemetry_series_overflow_total."""
+    from dmosopt_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry(series_limit=4)
+    for i in range(10):
+        reg.counter_inc("evals_total", 1.0, problem=str(i))
+    snap = reg.snapshot()["counters"]
+    series = snap["evals_total"]
+    assert len(series) == 5  # 4 real + 1 overflow
+    assert series["overflow=true"] == 6.0
+    assert sum(series.values()) == 10.0
+    assert reg.counter_value("telemetry_series_overflow_total") == 6.0
+
+    # existing series keep incrementing in place after the cap
+    reg.counter_inc("evals_total", 1.0, problem="0")
+    assert reg.counter_value("evals_total", problem="0") == 2.0
+
+    # unlabeled series and other metric names are unaffected
+    reg.counter_inc("evals_total")
+    assert reg.counter_value("evals_total") == 1.0
+    reg.gauge_set("tenants_active", 3.0)
+    assert reg.gauge_value("tenants_active") == 3.0
+
+
+def test_registry_series_limit_applies_per_store_kind():
+    from dmosopt_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry(series_limit=2)
+    for i in range(4):
+        reg.histogram_observe("phase_duration_seconds", 0.1, phase=str(i))
+    snap = reg.snapshot()["histograms"]["phase_duration_seconds"]
+    assert len(snap) == 3  # 2 real + overflow
+    assert snap["overflow=true"]["count"] == 2
+
+
+def test_telemetry_label_series_limit_knob():
+    from dmosopt_tpu.telemetry import Telemetry
+
+    tel = Telemetry(label_series_limit=1)
+    tel.inc("evals_total", problem="a")
+    tel.inc("evals_total", problem="b")
+    assert tel.registry.counter_value(
+        "telemetry_series_overflow_total"
+    ) == 1.0
+    tel.close()
+
+    # None disables the guard entirely
+    tel = Telemetry(label_series_limit=None)
+    for i in range(600):
+        tel.inc("evals_total", problem=str(i))
+    assert tel.registry.counter_value(
+        "telemetry_series_overflow_total"
+    ) == 0.0
+    tel.close()

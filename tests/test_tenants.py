@@ -1,0 +1,322 @@
+"""Problem-batched multi-tenant core (dmosopt_tpu.tenants).
+
+The regime-split contract: buckets of one (every single-problem run)
+take the UNCHANGED sequential path — pinned bitwise against the baked
+pre-PR trajectory hash — while buckets of two or more advance through
+one compiled program whose per-tenant results are pinned against the
+sequential path computed in the same process.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dmosopt_tpu
+from dmosopt_tpu import tenants
+from dmosopt_tpu.benchmarks.zdt import zdt1
+from dmosopt_tpu.driver import DistOptimizer, dopt_dict
+
+
+def _zdt1_params(opt_id, *, tenant_batching=False, problem_ids=None,
+                 n_epochs=2, population_size=16, num_generations=8,
+                 surrogate_extra=None, telemetry=False, **extra):
+    smk = {"n_starts": 2, "n_iter": 40, "seed": 0}
+    smk.update(surrogate_extra or {})
+    params = {
+        "opt_id": opt_id,
+        "obj_fun": zdt1,
+        "jax_objective": True,
+        "objective_names": ["f1", "f2"],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(6)},
+        "problem_parameters": {},
+        "n_initial": 4,
+        "n_epochs": n_epochs,
+        "population_size": population_size,
+        "num_generations": num_generations,
+        "resample_fraction": 0.5,
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": smk,
+        "random_seed": 17,
+        "telemetry": telemetry,
+        "tenant_batching": tenant_batching,
+    }
+    if problem_ids is not None:
+        params["problem_ids"] = problem_ids
+    params.update(extra)
+    return params
+
+
+# ------------------------------------------------- single-tenant bitwise pin
+
+
+def test_single_tenant_trajectory_bitwise_pinned_through_batched_core():
+    """tenant_batching=True with ONE problem must be byte-identical to
+    the pre-PR HEAD: the bucket-of-one routes through the sequential
+    path, so the archive hash equals the SAME baked SHA-256 the
+    predictor-era pin (tests/test_gp_predictor.py) froze."""
+    params = _zdt1_params(
+        "tenants_pin", tenant_batching=True, n_epochs=3,
+        population_size=24, num_generations=12,
+    )
+    dmosopt_tpu.run(params, verbose=False)
+    strat = dopt_dict["tenants_pin"].optimizer_dict[0]
+    x, y = strat.x, strat.y
+    assert x.shape == (48, 6) and y.shape == (48, 2)
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(x.astype(np.float32)).tobytes())
+    h.update(np.ascontiguousarray(y.astype(np.float32)).tobytes())
+    assert h.hexdigest() == (
+        "f62934d055ddfeba411ec700253d6d73ffabd199969d85fc2e8ae21f23783867"
+    ), (float(np.sum(x.astype(np.float64))), float(np.sum(y.astype(np.float64))))
+
+
+# ------------------------------------------------ batched vs sequential pins
+
+
+def test_two_problem_batched_matches_sequential_bitwise(monkeypatch):
+    """Two bucket-mates through the batched core produce per-tenant
+    archives bitwise-equal to the sequential loop (same seeds, same
+    process): the per-tenant PRNG streams are reproduced exactly and
+    the vmapped programs run the same math."""
+    routings = []
+    orig = tenants.initialize_epochs_batched
+
+    def spy(*a, **k):
+        r = orig(*a, **k)
+        routings.append(dict(r))
+        return r
+
+    # the driver imports the symbol from the module at call time, so
+    # patching the module attribute intercepts every epoch
+    monkeypatch.setattr(tenants, "initialize_epochs_batched", spy)
+
+    dmosopt_tpu.run(
+        _zdt1_params("tenants_seq2", problem_ids=set([0, 1])),
+        verbose=False,
+    )
+    dmosopt_tpu.run(
+        _zdt1_params(
+            "tenants_bat2", tenant_batching=True, problem_ids=set([0, 1]),
+        ),
+        verbose=False,
+    )
+    # every epoch of both problems actually rode the batched path
+    assert routings and all(
+        set(r.values()) == {"batched"} for r in routings
+    ), routings
+    seq = dopt_dict["tenants_seq2"]
+    bat = dopt_dict["tenants_bat2"]
+    for pid in (0, 1):
+        xs, ys = seq.optimizer_dict[pid].x, seq.optimizer_dict[pid].y
+        xb, yb = bat.optimizer_dict[pid].x, bat.optimizer_dict[pid].y
+        assert xs.shape == xb.shape and ys.shape == yb.shape
+        np.testing.assert_array_equal(xs, xb)
+        np.testing.assert_array_equal(ys, yb)
+
+
+def test_batched_epoch_emits_bucket_telemetry():
+    dmosopt_tpu.run(
+        _zdt1_params(
+            "tenants_tel", tenant_batching=True, problem_ids=set([0, 1]),
+            telemetry=True,
+        ),
+        verbose=False,
+    )
+    reg = dopt_dict["tenants_tel"].telemetry.registry
+    label = tenants.bucket_label(6, 2, 16)
+    assert reg.counter_value(
+        "tenant_bucket_epochs_total", bucket=label
+    ) == 2.0  # one per epoch
+    assert reg.counter_value("tenants_batched_total") == 4.0  # 2 x 2 epochs
+    assert reg.gauge_value("tenant_bucket_size", bucket=label) == 2.0
+
+
+# ------------------------------------------------------- component parity
+
+
+def test_fit_gp_problems_matches_per_problem_fits():
+    """The problems-axis fit is per-tenant bitwise-equal to standalone
+    `fit_gp_batch` calls at the same padding capacity (vmap lifts the
+    same program; per-problem Adam trajectories are independent)."""
+    from dmosopt_tpu.models.gp import (
+        _pad_to_bucket, fit_gp_batch, fit_gp_problems,
+    )
+
+    rng = np.random.default_rng(0)
+    cap = 64
+    Xs, Ys, Ms, keys = [], [], [], []
+    for i, N in enumerate([20, 35, 50]):
+        X = rng.uniform(size=(N, 3))
+        Y = rng.normal(size=(N, 2))
+        Xp, Yp, m = _pad_to_bucket(X, Y, cap=cap)
+        Xs.append(jnp.asarray(Xp, jnp.float32))
+        Ys.append(jnp.asarray(Yp, jnp.float32))
+        Ms.append(jnp.asarray(m, jnp.float32))
+        keys.append(jax.random.PRNGKey(i))
+
+    common = dict(n_starts=2, n_iter=30, convergence_tol=None)
+    fb = fit_gp_problems(
+        jnp.stack(keys), jnp.stack(Xs), jnp.stack(Ys), jnp.stack(Ms),
+        **common,
+    )
+    for i in range(3):
+        fs = fit_gp_batch(keys[i], Xs[i], Ys[i], train_mask=Ms[i], **common)
+        for name in ("amp", "ls", "noise", "alpha", "L", "nmll"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(fb, name)[i]),
+                np.asarray(getattr(fs, name)),
+                err_msg=f"problem {i} field {name}",
+            )
+
+
+def test_pad_to_bucket_cap_override():
+    from dmosopt_tpu.models.gp import _pad_to_bucket
+
+    X = np.zeros((10, 2))
+    Y = np.zeros((10, 1))
+    Xp, Yp, m = _pad_to_bucket(X, Y, cap=32)
+    assert Xp.shape == (32, 2) and Yp.shape == (32, 1)
+    assert m.sum() == 10
+    with pytest.raises(ValueError):
+        _pad_to_bucket(X, Y, cap=4)
+
+
+# ------------------------------------------------------- eligibility gates
+
+
+def test_eligibility_gates_route_sequential():
+    """Configs the batched core does not cover fall back per tenant —
+    and still complete the run."""
+    params = _zdt1_params(
+        "tenants_gate", tenant_batching=True, problem_ids=set([0, 1]),
+        telemetry=True, n_epochs=1, num_generations=4,
+        # a termination criterion is host-side state: sequential path
+        termination_conditions={"strategy": "simple", "n_max_gen": 4},
+    )
+    dmosopt_tpu.run(params, verbose=False)
+    reg = dopt_dict["tenants_gate"].telemetry.registry
+    assert reg.counter_value("tenants_sequential_total") >= 2.0
+    assert reg.counter_value("tenants_batched_total") == 0.0
+
+
+def test_batch_eligibility_reasons():
+    class FakeStrat:
+        x = np.zeros((8, 3))
+        optimizer_name = ("nsga2",)
+        optimizer_kwargs = ({},)
+        surrogate_method_name = "gpr"
+        surrogate_method_kwargs = {}
+        surrogate_custom_training = None
+        sensitivity_method_name = None
+        feasibility_method_name = None
+        optimize_mean_variance = False
+        termination = None
+        refit_controller = None
+        mesh = None
+        distance_metric = None
+        num_generations = 10
+
+    ok = FakeStrat()
+    assert tenants.batch_eligibility(ok) is None
+
+    cases = [
+        ("x", None, "empty archive"),
+        ("optimizer_name", ("nsga2", "age"), "cycled"),
+        ("optimizer_name", ("smpso",), "not batchable"),
+        ("surrogate_method_name", "svgp", "not batchable"),
+        ("optimize_mean_variance", True, "mean-variance"),
+        ("termination", object(), "termination"),
+        ("mesh", object(), "mesh"),
+        ("surrogate_method_kwargs", {"predictor": "matmul"}, "predictor"),
+        ("surrogate_method_kwargs", {"dtype": "float64"}, "float32"),
+        ("surrogate_method_kwargs", {"surrogate_mesh": True}, "kwargs"),
+        ("optimizer_kwargs", ({"adaptive_population_size": True},),
+         "adaptive"),
+    ]
+    for attr, value, needle in cases:
+        s = FakeStrat()
+        setattr(s, attr, value)
+        reason = tenants.batch_eligibility(s)
+        assert reason is not None and needle in reason, (attr, reason)
+
+
+# ------------------------------------------------- stats cardinality guard
+
+
+def _driver_with_fake_strategies(opt_id, n_problems, **kwargs):
+    d = DistOptimizer(
+        opt_id, zdt1, jax_objective=True,
+        objective_names=["f1", "f2"],
+        space={"x0": [0.0, 1.0], "x1": [0.0, 1.0]},
+        problem_parameters={},
+        problem_ids=set(range(n_problems)),
+        telemetry=False,
+        **kwargs,
+    )
+    from types import SimpleNamespace
+
+    for pid in d.problem_ids:
+        d.optimizer_dict[pid] = SimpleNamespace(
+            stats={"model_init_start": 10.0, "model_init_end": 11.0 + pid,
+                   "eval_mean": 0.5 + pid}
+        )
+    return d
+
+
+def test_get_stats_aggregates_beyond_limit():
+    n = DistOptimizer._STATS_PER_PROBLEM_LIMIT + 4
+    d = _driver_with_fake_strategies("stats_agg", n)
+    out = d.get_stats()
+    # no per-problem prefixes at 20 problems: flat in tenant count
+    assert not any(k.startswith(f"{n - 1}_") for k in out)
+    assert out["stats_n_problems"] == n
+    assert out["model_init_mean"] == pytest.approx(
+        np.mean([1.0 + pid for pid in range(n)])
+    )
+    assert out["eval_mean_mean"] == pytest.approx(
+        np.mean([0.5 + pid for pid in range(n)])
+    )
+
+
+def test_get_stats_per_problem_below_limit_unchanged():
+    d = _driver_with_fake_strategies("stats_pp", 2)
+    out = d.get_stats()
+    assert out["0_model_init"] == pytest.approx(1.0)
+    assert out["1_model_init"] == pytest.approx(2.0)
+    assert "stats_n_problems" not in out
+
+
+def test_get_stats_per_problem_forced_beyond_limit():
+    n = DistOptimizer._STATS_PER_PROBLEM_LIMIT + 4
+    d = _driver_with_fake_strategies("stats_force", n, stats_per_problem=True)
+    out = d.get_stats()
+    assert out[f"{n - 1}_model_init"] == pytest.approx(float(n))
+
+
+def test_stats_per_problem_validation():
+    with pytest.raises(ValueError, match="stats_per_problem"):
+        _driver_with_fake_strategies("stats_bad", 2, stats_per_problem="yes")
+
+
+def test_batched_tenants_carry_fit_stats():
+    """The batched path records the same stats["objective"] fit summary
+    the sequential epoch gets from mdl.get_stats()."""
+    dmosopt_tpu.run(
+        _zdt1_params(
+            "tenants_stats", tenant_batching=True, problem_ids=set([0, 1]),
+        ),
+        verbose=False,
+    )
+    for pid in (0, 1):
+        obj = dopt_dict["tenants_stats"].optimizer_dict[pid].stats["objective"]
+        assert set(obj) >= {
+            "loss", "nmll_per_objective", "n_steps", "n_iter_max",
+            "early_stopped",
+        }
+        assert np.isfinite(obj["loss"]) and len(obj["nmll_per_objective"]) == 2
+        assert 0 < obj["n_steps"] <= obj["n_iter_max"] == 40

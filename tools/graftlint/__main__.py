@@ -13,6 +13,12 @@ Options:
     --hot             print every jit-region function with provenance
     --frozen-hashes   print current normalized hashes of all registered
                       frozen functions (copy-paste for registry bumps)
+    --bump-frozen N   rewrite tools/graftlint/frozen_registry.py hashes
+                      from the CURRENT source for the named qualnames
+                      (comma list, or "all"); pair every bump with a
+                      re-bake of the run-time pins the entry names
+    --registry-file P registry file --bump-frozen rewrites (tests;
+                      default tools/graftlint/frozen_registry.py)
 
 Exit status: 0 when no unsuppressed findings, 1 otherwise, 2 on usage
 errors.
@@ -72,6 +78,25 @@ def _print_frozen_hashes(targets) -> int:
     return 0
 
 
+def _bump_frozen(targets, spec: str, registry_file) -> int:
+    from tools.graftlint.bump import bump_frozen
+
+    names = [n.strip() for n in spec.split(",") if n.strip()]
+    changed = bump_frozen(
+        REPO_ROOT, targets, names, registry_path=registry_file
+    )
+    if not changed:
+        print("graftlint: frozen registry already in sync — no bump needed")
+        return 0
+    for name, (old, new) in sorted(changed.items()):
+        print(f"{name}: {old[:12]}… -> {new[:12]}…")
+    print(
+        f"graftlint: bumped {len(changed)} frozen hash(es); re-bake the "
+        f"run-time pins named in each entry's pinned_by"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="graftlint", add_help=True)
     ap.add_argument("paths", nargs="*", default=None)
@@ -80,6 +105,8 @@ def main(argv=None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--hot", action="store_true")
     ap.add_argument("--frozen-hashes", action="store_true")
+    ap.add_argument("--bump-frozen", default=None, metavar="NAMES")
+    ap.add_argument("--registry-file", default=None)
     args = ap.parse_args(argv)
 
     targets = args.paths or list(DEFAULT_TARGETS)
@@ -93,6 +120,8 @@ def main(argv=None) -> int:
             return _print_hot(targets)
         if args.frozen_hashes:
             return _print_frozen_hashes(targets)
+        if args.bump_frozen:
+            return _bump_frozen(targets, args.bump_frozen, args.registry_file)
         findings = run_lint(REPO_ROOT, targets, rules=rules)
     except (KeyError, ValueError) as e:
         print(f"graftlint: {e.args[0]}", file=sys.stderr)

@@ -1,0 +1,127 @@
+"""Frozen-registry bump helper: rewrite baked source hashes in place.
+
+The frozen-path guard (rules/frozen_path.py) bakes a normalized-source
+SHA-256 per registered qualname; editing a frozen function turns
+``make lint`` red until the registry is bumped. The manual procedure in
+docs/static-analysis.md (run ``--frozen-hashes``, paste each hex back
+into ``frozen_registry.py``) is error-prone when a refactor touches
+several frozen paths at once — ``--bump-frozen`` performs it
+mechanically:
+
+    python -m tools.graftlint --bump-frozen all
+    python -m tools.graftlint --bump-frozen dmosopt_tpu.models.gp.fit_gp_batch
+
+Only the ``"sha256"`` hex of each named entry changes; reasons,
+``pinned_by`` pointers, and comments stay untouched — a bump is a
+statement that the CURRENT source is the newly frozen program, so the
+run-time pins named in ``pinned_by`` must be re-baked in the same
+change (the registry records the lint-time half only).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, Iterable, Optional, Tuple
+
+from tools.graftlint.engine import frozen_hash, load_context
+
+DEFAULT_REGISTRY = Path(__file__).resolve().parent / "frozen_registry.py"
+
+
+def registered_names(registry_path: Optional[Path] = None):
+    """Qualnames registered in the registry file (textual scan — the
+    file stays importable, but the bump operates on source text so it
+    can run against sandbox copies in tests)."""
+    path = Path(registry_path or DEFAULT_REGISTRY)
+    return re.findall(
+        r'^\s*["\']([A-Za-z_][\w.]*)["\']\s*:\s*\{', path.read_text(), re.M
+    )
+
+
+def _entry_span(text: str, name: str):
+    """(begin, end) character offsets of the registry VALUE dict for
+    `name`, located via the AST so string contents can never skew the
+    boundary. Works on any module-level dict literal whose keys are
+    string constants (the FROZEN registry shape)."""
+    import ast
+
+    tree = ast.parse(text)
+    lines = text.splitlines(keepends=True)
+    starts = [0]
+    for ln in lines:
+        starts.append(starts[-1] + len(ln))
+
+    def offset(lineno, col):
+        return starts[lineno - 1] + col
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if (
+                isinstance(k, ast.Constant)
+                and k.value == name
+                and isinstance(v, ast.Dict)
+            ):
+                return (
+                    offset(v.lineno, v.col_offset),
+                    offset(v.end_lineno, v.end_col_offset),
+                )
+    raise KeyError(f"registry entry for {name!r} not found")
+
+
+def bump_frozen(
+    repo_root,
+    targets: Iterable[str],
+    names: Iterable[str],
+    registry_path: Optional[Path] = None,
+) -> Dict[str, Tuple[str, str]]:
+    """Rewrite the ``"sha256"`` entries for `names` (or every registered
+    name, for ``["all"]``) with the hash of the CURRENT normalized
+    source. Returns {qualname: (old_hash, new_hash)} for the entries
+    that actually changed; raises KeyError for names missing from the
+    registry or the lint targets."""
+    path = Path(registry_path or DEFAULT_REGISTRY)
+    text = path.read_text()
+    known = registered_names(path)
+    names = list(names)
+    if names == ["all"]:
+        names = known
+    unknown = sorted(set(names) - set(known))
+    if unknown:
+        raise KeyError(
+            f"not in the frozen registry ({path.name}): {unknown}"
+        )
+
+    ctx = load_context(Path(repo_root), tuple(targets))
+    changed: Dict[str, Tuple[str, str]] = {}
+    for name in names:
+        info = ctx.functions.get(name)
+        if info is None:
+            raise KeyError(
+                f"frozen function {name!r} not found in lint targets "
+                f"{tuple(targets)}"
+            )
+        new = frozen_hash(info.node)
+        # scope the sha256 search to THIS entry's value dict, with the
+        # span taken from the AST (immune to braces inside reason
+        # strings): a lazy cross-entry match would silently rewrite the
+        # NEXT entry's hash when the named entry is missing its own
+        begin, end = _entry_span(text, name)
+        m = re.search(
+            r'(["\']sha256["\']\s*:\s*["\'])([0-9a-f]{64})',
+            text[begin:end],
+        )
+        if m is None:
+            raise KeyError(
+                f"registry entry for {name!r} has no sha256 line"
+            )
+        start = begin + m.start(2)
+        old = m.group(2)
+        if old != new:
+            text = text[:start] + new + text[start + 64:]
+            changed[name] = (old, new)
+    if changed:
+        path.write_text(text)
+    return changed
