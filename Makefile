@@ -24,9 +24,18 @@ lint:
 
 # every metric name emitted in the package must be cataloged in
 # docs/observability.md (also enforced inside the fast suite); now an
-# alias over graftlint's metrics-catalog rule
+# alias over graftlint's metrics-catalog rule (which additionally
+# holds tracing SPAN names to the same catalog via `make lint`)
 lint-metrics:
 	$(PY) tools/lint_metrics.py
+
+# tracing smoke gate: a 2-tenant toy service with tracing enabled; the
+# exported Chrome trace must be schema-valid, carry the nested
+# epoch -> gp_fit/ea_scan -> tenant_cost spans with tenant labels, and
+# the attributed per-tenant seconds must sum to the bucket walls
+# within 5% (docs/observability.md "Tracing and cost attribution")
+trace-smoke:
+	$(PY) tools/trace_smoke.py
 
 bench:
 	python bench.py

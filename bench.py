@@ -83,6 +83,37 @@ def _warn_loud(msg: str) -> None:
     bar = "!" * 72
     print(f"{bar}\nbench: WARNING: {msg}\n{bar}", file=sys.stderr)
 
+
+# Bench tracing (ISSUE 9 self-id): set DMOSOPT_BENCH_TRACE_DIR to a
+# directory and every driver-backed config exports a Chrome trace-event
+# JSON per run, recording its path in the config's result line — a
+# BENCH_* artifact then names the timeline that explains its walls.
+# Off by default: tracing adds the (tiny) telemetry layer to configs
+# that normally measure with telemetry=False.
+_TRACE_DIR_ENV = "DMOSOPT_BENCH_TRACE_DIR"
+
+
+def _bench_trace_path(tag):
+    """Trace export path for one driver run when bench tracing is
+    enabled (DMOSOPT_BENCH_TRACE_DIR), else None."""
+    trace_dir = os.environ.get(_TRACE_DIR_ENV)
+    if not trace_dir:
+        return None
+    os.makedirs(trace_dir, exist_ok=True)
+    return os.path.join(trace_dir, f"{tag}.trace.json")
+
+
+def _apply_bench_tracing(params, row):
+    """Route one driver-config run's telemetry through a Chrome trace
+    export when bench tracing is enabled, recording `trace_path` in the
+    config's result row. Leaves the params untouched (and the row
+    without a trace_path key) when tracing is off."""
+    path = _bench_trace_path(params["opt_id"])
+    if path is not None:
+        params["telemetry"] = {"trace_path": path}
+        row["trace_path"] = path
+    return params
+
 # Config-1 constants re-measured 2026-07-30 (round 5) via
 # tools/refbench/measure_config1.py; 07-29 values (20.38 / 8.12 s)
 # reproduced within ~10%. NOTE: these were single-shot measurements;
@@ -208,14 +239,16 @@ def bench_zdt_agemoea():
             "surrogate_method_kwargs": {"n_starts": 4, "n_iter": 100, "seed": 0},
             "random_seed": 42,
         }
+        row = {}
+        params = _apply_bench_tracing(params, row)
         t0 = time.time()
         best = dmosopt_tpu.run(params, verbose=False)
         wall = time.time() - t0
         prms, lres = best
         y = np.column_stack([v for _, v in lres])
         key = f"{name}_agemoea_gpr"
-        row = {"wall_sec": round(wall, 2), "n_best": int(y.shape[0]),
-               "vs_reference_cpu": _vs(wall, key)}
+        row.update({"wall_sec": round(wall, 2), "n_best": int(y.shape[0]),
+                    "vs_reference_cpu": _vs(wall, key)})
         if front is not None:
             d = distance_to_front(y, front)
             row["within_0.05"] = int((d < 0.05).sum())
@@ -253,18 +286,19 @@ def bench_tnk():
         "feasibility_method_name": "logreg",
         "random_seed": 42,
     }
+    row = {}
+    params = _apply_bench_tracing(params, row)
     t0 = time.time()
     best = dmosopt_tpu.run(params, verbose=False)
     wall = time.time() - t0
     prms, lres = best
     y = np.column_stack([v for _, v in lres])
-    return {
-        "tnk_constrained": {
-            "wall_sec": round(wall, 2),
-            "n_best": int(y.shape[0]),
-            "vs_reference_cpu": _vs(wall, "tnk_constrained"),
-        }
-    }
+    row.update(
+        wall_sec=round(wall, 2),
+        n_best=int(y.shape[0]),
+        vs_reference_cpu=_vs(wall, "tnk_constrained"),
+    )
+    return {"tnk_constrained": row}
 
 
 # Config-4 definitions, shared with tests/test_benchmarks.py's DTLZ7
@@ -312,6 +346,8 @@ def bench_dtlz_many_objective():
     out = {}
     for prob in ("dtlz2", "dtlz7"):
         params = dict(dtlz_bench_params(prob), obj_fun=get_problem(prob, 5))
+        row = {}
+        params = _apply_bench_tracing(params, row)
         t0 = time.time()
         dmosopt_tpu.run(params, verbose=False)
         wall = time.time() - t0
@@ -322,14 +358,15 @@ def bench_dtlz_many_objective():
         hv = AdaptiveHyperVolume(np.asarray(ref), epsilon=0.02)
         final_hv = float(hv.compute_hypervolume(y))
         key = f"{prob}_5obj_dim100"
-        out[key] = {
-            "wall_sec": round(wall, 2),
-            "final_hv": round(final_hv, 4),
-            "hv_vs_reference_final": round(final_hv / ref_hv, 3),
-            "hv_method": hv.last_method,
-            "n_archive": int(y.shape[0]),
-            "vs_reference_cpu": _vs(wall, key),
-        }
+        row.update(
+            wall_sec=round(wall, 2),
+            final_hv=round(final_hv, 4),
+            hv_vs_reference_final=round(final_hv / ref_hv, 3),
+            hv_method=hv.last_method,
+            n_archive=int(y.shape[0]),
+            vs_reference_cpu=_vs(wall, key),
+        )
+        out[key] = row
     return out
 
 
@@ -558,17 +595,20 @@ def bench_gp_refit():
             "surrogate_refit": refit,
             "random_seed": 42,
         }
+        row = {}
+        params = _apply_bench_tracing(params, row)
         t0 = time.time()
         best = dmosopt_tpu.run(params, verbose=False)
         wall = time.time() - t0
         _, lres = best
         y = np.column_stack([v for _, v in lres])
         d = distance_to_front(y, front)
-        return {
-            "wall_sec": round(wall, 2),
-            "n_best": int(y.shape[0]),
-            "within_0.05": int((d < 0.05).sum()),
-        }
+        row.update(
+            wall_sec=round(wall, 2),
+            n_best=int(y.shape[0]),
+        )
+        row["within_0.05"] = int((d < 0.05).sum())
+        return row
 
     cold_e2e = run_zdt1("bench_gp_refit_cold", "cold")
     warm_e2e = run_zdt1("bench_gp_refit_warm", "warm")
@@ -738,17 +778,20 @@ def _bench_predict_e2e():
             },
             "random_seed": 42,
         }
+        row = {}
+        params = _apply_bench_tracing(params, row)
         t0 = time.time()
         best = dmosopt_tpu.run(params, verbose=False)
         wall = time.time() - t0
         _, lres = best
         y = np.column_stack([v for _, v in lres])
         d = distance_to_front(y, front)
-        return {
-            "wall_sec": round(wall, 2),
-            "n_best": int(y.shape[0]),
-            "within_0.05": int((d < 0.05).sum()),
-        }
+        row.update(
+            wall_sec=round(wall, 2),
+            n_best=int(y.shape[0]),
+        )
+        row["within_0.05"] = int((d < 0.05).sum())
+        return row
 
     # best-of-2 per mode (the framework's standard methodology); the
     # matmul trajectory visits predict programs solve never compiles,
@@ -799,6 +842,8 @@ def bench_pipeline_overlap():
         g = 1.0 + 9.0 / (dim - 1) * np.sum(x[1:])
         return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
 
+    trace_paths = {}
+
     def run_once(opt_id, pipeline):
         params = {
             "opt_id": opt_id,
@@ -818,6 +863,10 @@ def bench_pipeline_overlap():
             "telemetry": False,
             "pipeline": pipeline,
         }
+        row = {}
+        params = _apply_bench_tracing(params, row)
+        if row:
+            trace_paths[opt_id] = row["trace_path"]
         t0 = time.time()
         dmosopt_tpu.run(params, verbose=False)
         return time.time() - t0
@@ -858,6 +907,7 @@ def bench_pipeline_overlap():
             "sleep_per_call_sec": round(state["sleep"], 3),
             "fit_ea_sec_per_epoch": round(fit_sec, 2),
             "evals_per_drain": round(batch, 1),
+            **({"trace_paths": trace_paths} if trace_paths else {}),
         }
     }
 
@@ -929,7 +979,9 @@ def bench_multi_tenant(tenant_counts=None):
         )
     dim, pop, ngen, n_epochs = 4, 16, 8, 2
 
-    def run_once(tag, T):
+    trace_paths = {}
+
+    def _params(tag, T, telemetry):
         params = {
             "opt_id": tag,
             "obj_fun": zdt1,
@@ -948,14 +1000,73 @@ def bench_multi_tenant(tenant_counts=None):
                 "n_starts": 2, "n_iter": 40, "seed": 0,
             },
             "random_seed": 17,
-            "telemetry": False,
+            "telemetry": telemetry,
             "tenant_batching": True,
         }
         if T > 1:
             params["problem_ids"] = set(range(T))
+        return params
+
+    def run_once(tag, T):
+        params = _params(tag, T, False)
+        row = {}
+        params = _apply_bench_tracing(params, row)
+        if row:
+            trace_paths[tag] = row["trace_path"]
         t0 = time.time()
         dmosopt_tpu.run(params, verbose=False)
         return time.time() - t0
+
+    def attribution_run(T):
+        """One INSTRUMENTED run at the largest tenant count (outside the
+        timed best-of-2 cells): per-tenant attributed fit/EA/compile
+        seconds from the batched core's cost attribution
+        (docs/observability.md "Tracing and cost attribution"), so the
+        BENCH_* artifact shows where a shared bucket's wall actually
+        went per tenant — bucket-sharing overhead made visible."""
+        from dmosopt_tpu.driver import dopt_dict
+
+        tag = f"mt_attr_{T}"
+        params = _params(tag, T, True)
+        params = _apply_bench_tracing(params, {})
+        dmosopt_tpu.run(params, verbose=False)
+        d = dopt_dict[tag]
+        series = (
+            d.telemetry.registry.snapshot()["counters"]
+            .get("tenant_cost_seconds", {})
+        )
+        per_phase = {}
+        per_tenant = {}
+        overflow_sec = 0.0
+        for label, v in series.items():
+            kv = dict(pair.split("=", 1) for pair in label.split(","))
+            if "phase" not in kv or "tenant" not in kv:
+                # the registry's label-cardinality guard collapses
+                # past-limit series into one {overflow="true"} set —
+                # reachable at T >= 171 via DMOSOPT_BENCH_TENANTS
+                overflow_sec += v
+                continue
+            per_phase[kv["phase"]] = per_phase.get(kv["phase"], 0.0) + v
+            per_tenant[kv["tenant"]] = per_tenant.get(kv["tenant"], 0.0) + v
+        bucket_walls = sum(
+            ev.fields.get("fit_s", 0.0) + ev.fields.get("ea_s", 0.0)
+            for ev in d.telemetry.log.records(kind="tenant_bucket")
+        )
+        vals = sorted(per_tenant.values())
+        out = {
+            "tenants": T,
+            "attributed_seconds": {
+                k: round(v, 3) for k, v in sorted(per_phase.items())
+            },
+            "bucket_wall_seconds": round(bucket_walls, 3),
+            "per_tenant_mean_sec": (
+                round(sum(vals) / len(vals), 4) if vals else None
+            ),
+            "per_tenant_max_sec": round(vals[-1], 4) if vals else None,
+        }
+        if overflow_sec:
+            out["series_overflow_sec"] = round(overflow_sec, 3)
+        return out
 
     out = {
         "problem": f"zdt1 d={dim} pop={pop} gens={ngen} epochs={n_epochs}",
@@ -980,6 +1091,11 @@ def bench_multi_tenant(tenant_counts=None):
                 out[f"tenants_{T}"]["wall_vs_single"] = round(
                     walls[T] / single, 2
                 )
+    T_attr = max(tenant_counts)
+    if T_attr > 1:
+        out["attribution"] = attribution_run(T_attr)
+    if trace_paths:
+        out["trace_paths"] = trace_paths
     out["loadavg_end"] = [round(v, 2) for v in os.getloadavg()]
     return {"multi_tenant": out}
 
@@ -1123,6 +1239,10 @@ def child_main():
         "loadavg_start": [round(v, 2) for v in os.getloadavg()],
         "cpu_count": os.cpu_count(),
     }
+    if os.environ.get(_TRACE_DIR_ENV):
+        # bench tracing on: driver-backed configs export Chrome traces
+        # and carry per-run trace_path keys in their result rows
+        result["trace_dir"] = os.environ[_TRACE_DIR_ENV]
     _emit_partial(result)
 
     if os.environ.get("DMOSOPT_BENCH_SMOKE"):

@@ -1,4 +1,4 @@
-"""Command-line tools: analyze / train / onestep / telemetry.
+"""Command-line tools: analyze / train / onestep / telemetry / status.
 
 Capability match: the reference ships three click commands —
 `dmosopt-analyze` (Pareto extraction + kNN-to-origin ranking,
@@ -8,7 +8,9 @@ resample step from a store, dmosopt_onestep.py). The reference CLIs are
 stale against their own store API (SURVEY §3.5); these implement the
 same intent against the dmosopt_tpu HDF5 schema. `telemetry` is new:
 it renders the per-epoch observability summaries the driver persists
-(docs/observability.md) as a phase/throughput table.
+(docs/observability.md) as a phase/throughput table. `status` renders
+the live-service introspection snapshot an
+`OptimizationService(status_path=...)` publishes after every step.
 """
 
 from __future__ import annotations
@@ -394,6 +396,79 @@ def telemetry(file_path, opt_id, problem_id, with_hv, output_file):
         click.echo(f"wrote {output_file}")
 
 
+@click.command("status")
+@click.option("--status-file", "-p", required=True,
+              type=click.Path(exists=True),
+              help="JSON snapshot the service writes after every step "
+                   "(OptimizationService(status_path=...))")
+@click.option("--as-json", "as_json", is_flag=True,
+              help="emit the raw snapshot JSON instead of the table")
+def status(status_file, as_json):
+    """Live-service introspection: render the snapshot an
+    `OptimizationService(status_path=...)` publishes after every step —
+    tenants with epoch/state/attributed cost, queue depths, writer
+    backlog, telemetry series-overflow state, and the loadavg-normalized
+    throughput check (docs/observability.md)."""
+    with open(status_file) as fh:
+        snap = json.load(fh)
+    if as_json:
+        click.echo(json.dumps(snap, indent=2, default=json_default))
+        return
+
+    counts = snap.get("tenant_counts", {})
+    counts_str = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    qd = snap.get("queue_depths", {})
+    click.echo(
+        f"service: steps={snap.get('steps', 0)} "
+        f"closed={snap.get('closed', False)} {counts_str}"
+    )
+    click.echo(
+        f"queues: pending_submissions={qd.get('pending_submissions', 0)} "
+        f"writer_backlog={qd.get('writer_backlog', 0)} "
+        f"series_overflow_total={snap.get('series_overflow_total', 0)}"
+    )
+    thr = snap.get("throughput", {})
+    line = (
+        f"throughput: {thr.get('status', 'no_data')} "
+        f"(last {_fmt(thr.get('last_step_s_per_tenant'), 0, 4)}s/tenant, "
+        f"best {_fmt(thr.get('best_step_s_per_tenant'), 0, 4)}s/tenant, "
+        f"load {_fmt(thr.get('loadavg_1m'), 0, 2)}"
+        f"/{thr.get('cpu_count', '-')} cpus)"
+    )
+    click.echo(line)
+    if thr.get("note"):
+        click.echo(f"  note: {thr['note']}")
+    last = snap.get("last_step", {})
+    if last.get("phases"):
+        click.echo(
+            "last step: "
+            + " ".join(
+                f"{k}={v:.3f}s" for k, v in last["phases"].items()
+            )
+            + f" (wall {_fmt(last.get('wall_s'), 0, 3)}s)"
+        )
+    tenants = snap.get("tenants", [])
+    if tenants:
+        header = (
+            f"{'tenant':>20} {'state':>10} {'epoch':>8} {'fit_s':>8} "
+            f"{'ea_s':>8} {'compile_s':>10} {'gens/s':>8}"
+        )
+        click.echo(header)
+        click.echo("-" * len(header))
+        for t in tenants:
+            cost = t.get("cost_seconds", {})
+            click.echo(
+                f"{t.get('opt_id', '?'):>20} {t.get('state', '?'):>10} "
+                f"{str(t.get('epoch', '-')) + '/' + str(t.get('n_epochs', '-')):>8} "
+                + _fmt(cost.get("fit"), 8, 3) + " "
+                + _fmt(cost.get("ea"), 8, 3) + " "
+                + _fmt(cost.get("compile"), 10, 3) + " "
+                + _fmt(t.get("gens_per_sec"), 8)
+            )
+    if snap.get("trace_path"):
+        click.echo(f"trace: {snap['trace_path']}")
+
+
 @click.group()
 def cli():
     """dmosopt-tpu command-line tools."""
@@ -403,6 +478,7 @@ cli.add_command(analyze)
 cli.add_command(train)
 cli.add_command(onestep)
 cli.add_command(telemetry)
+cli.add_command(status)
 
 
 def main():  # console entry point

@@ -51,7 +51,12 @@ from dmosopt_tpu.models.predictor import set_predictor_telemetry
 from dmosopt_tpu.ops.dominance import set_rank_telemetry
 from dmosopt_tpu.parallel.pipeline import BackgroundWriter, PipelineConfig
 from dmosopt_tpu.strategy import DistOptStrategy
-from dmosopt_tpu.telemetry import Telemetry, create_telemetry, record_device_memory
+from dmosopt_tpu.telemetry import (
+    Telemetry,
+    create_telemetry,
+    record_device_memory,
+    span_scope,
+)
 from dmosopt_tpu.utils.prng import as_generator
 from dmosopt_tpu.utils.profiling import device_trace, eval_time_stats
 
@@ -230,10 +235,12 @@ class DistOptimizer:
             ``audit_every``, ``warm_iter_cap``) or a ready-made config
             — see docs/surrogates.md. Warm state is persisted with the
             checkpoint so a resumed run stays warm.
-          telemetry: None/True for the on-by-default metrics + event log,
-            False for none at all (zero telemetry calls on the hot
-            path), a dict of `dmosopt_tpu.telemetry.Telemetry` kwargs
-            (ring_size, jsonl_path, profile_dir, profile_epochs, ...),
+          telemetry: None/True for the on-by-default metrics + event
+            log + span tracer, False for none at all (zero telemetry
+            calls on the hot path), a dict of
+            `dmosopt_tpu.telemetry.Telemetry` kwargs (ring_size,
+            jsonl_path, profile_dir, profile_epochs, trace_path for a
+            Chrome trace-event export of the host span timeline, ...),
             or a ready-made Telemetry instance — see
             docs/observability.md.
           tenant_batching: route multi-problem epochs through the
@@ -817,7 +824,8 @@ class DistOptimizer:
         sequence of states the serial loop produces; the pipeline changes
         when the driver blocks, never what is written."""
         if not self.pipeline.overlaps_io:
-            fn(*args, **kwargs)
+            with span_scope(self.telemetry, "h5_write"):
+                fn(*args, **kwargs)
             return
         if self._writer is None:
             self._writer = BackgroundWriter(telemetry=self.telemetry)
@@ -918,16 +926,28 @@ class DistOptimizer:
     def save_telemetry(self, epoch):
         """Persist this epoch's telemetry summary into the HDF5
         `telemetry` group (process-0 only, like every other write) so a
-        resumed run keeps the full per-epoch history."""
+        resumed run keeps the full per-epoch history. Spans closed since
+        the previous epoch's persist ride along into the
+        `telemetry_spans` group (writer spans that close after this
+        drain land with the following epoch)."""
         if self.telemetry is None or not self.save or not _is_primary_process():
             return
-        from dmosopt_tpu.storage import save_telemetry_to_h5
+        from dmosopt_tpu.storage import save_spans_to_h5, save_telemetry_to_h5
 
         self._submit_write(
             save_telemetry_to_h5,
             self.opt_id, epoch, self.telemetry.epoch_summary(epoch),
             self.file_path, self.logger,
         )
+        tracer = self.telemetry.tracer
+        if tracer is not None:
+            spans = tracer.drain()
+            if spans:
+                self._submit_write(
+                    save_spans_to_h5,
+                    self.opt_id, epoch, [s.to_dict() for s in spans],
+                    self.file_path, self.logger,
+                )
 
     # ------------------------------------------------------------ queries
 
@@ -1194,12 +1214,14 @@ class DistOptimizer:
         # A time-limit expiry mid-reconcile keeps the batch parked so
         # the teardown salvage (_abandon_inflight) still sees it
         still_inflight = []
-        for st in self._inflight:
-            self._advance_inflight(st, round_times, st.total)
-            if st.next_fold < st.total:
-                still_inflight.append(st)
-            else:
-                self._finish_inflight_telemetry(st)
+        if self._inflight:
+            with span_scope(tel, "eval_drain", stage="reconcile"):
+                for st in self._inflight:
+                    self._advance_inflight(st, round_times, st.total)
+                    if st.next_fold < st.total:
+                        still_inflight.append(st)
+                    else:
+                        self._finish_inflight_telemetry(st)
         self._inflight = still_inflight
 
         has_requests = any(
@@ -1213,13 +1235,12 @@ class DistOptimizer:
 
             if self._use_async():
                 cfg = self.pipeline
-                st = _InflightBatch(
-                    self.evaluator.submit_batch(
+                with span_scope(tel, "eval_dispatch", n_rounds=len(task_args)):
+                    handle = self.evaluator.submit_batch(
                         task_args, timeout=cfg.eval_timeout,
                         retries=cfg.eval_retries, n_chunks=cfg.jax_eval_chunks,
-                    ),
-                    task_reqs,
-                )
+                    )
+                st = _InflightBatch(handle, task_reqs)
                 quorum = st.total
                 if allow_quorum and cfg.speculative and self.epoch_count > 0:
                     # never speculate on the initial design (epoch 0 /
@@ -1228,7 +1249,8 @@ class DistOptimizer:
                     quorum = max(
                         1, int(np.ceil(cfg.quorum_fraction * st.total))
                     )
-                self._advance_inflight(st, round_times, quorum)
+                with span_scope(tel, "eval_drain", n_rounds=st.total):
+                    self._advance_inflight(st, round_times, quorum)
                 if st.next_fold < st.total:
                     # quorum reached (or soft time-limit stop): the rest
                     # keep evaluating behind the caller's surrogate fit
@@ -1243,9 +1265,10 @@ class DistOptimizer:
                 else:
                     self._finish_inflight_telemetry(st)
             else:
-                results = self.evaluator.evaluate_batch(task_args)
-                for res, round_reqs in zip(results, task_reqs):
-                    self._fold_round(res, round_reqs, round_times)
+                with span_scope(tel, "eval_drain", n_rounds=len(task_args)):
+                    results = self.evaluator.evaluate_batch(task_args)
+                    for res, round_reqs in zip(results, task_reqs):
+                        self._fold_round(res, round_reqs, round_times)
 
             if (
                 self.save
@@ -1363,7 +1386,7 @@ class DistOptimizer:
                 trace_ctx = device_trace(tel.profile_dir)
                 tel.event("trace", profile_dir=tel.profile_dir)
 
-        with trace_ctx:
+        with trace_ctx, span_scope(tel, "epoch", epoch=epoch):
             self.stats["init_sampling_start"] = time.time()
             # the epoch-opening drain evaluates the previous epoch's
             # resample batch — the one place speculative mode may return

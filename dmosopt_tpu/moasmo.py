@@ -22,12 +22,13 @@ level* (cheap, runs once per epoch); everything inside is jitted.
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import itertools
 import time
 from typing import Any, Dict, Optional, Tuple
 
-from dmosopt_tpu.telemetry import phase_scope
+from dmosopt_tpu.telemetry import phase_scope, span_scope
 
 import numpy as np
 import jax
@@ -976,16 +977,17 @@ def epoch(
                 logger.warning(f"Unable to fit feasibility model: {e}")
 
     if surrogate_method_name is not None and mdl.objective is None:
-        with phase_scope(telemetry, "train") as ph:
-            mdl.objective = train(
-                nInput, nOutput, xlb, xub, Xinit, Yinit, C,
-                surrogate_method_name=surrogate_method_name,
-                surrogate_method_kwargs=surrogate_method_kwargs,
-                surrogate_return_mean_variance=optimize_mean_variance,
-                logger=logger, file_path=file_path, mesh=mesh,
-                info=ph, surrogate_refit=surrogate_refit,
-                telemetry=telemetry,
-            )
+        with span_scope(telemetry, "gp_fit"):
+            with phase_scope(telemetry, "train") as ph:
+                mdl.objective = train(
+                    nInput, nOutput, xlb, xub, Xinit, Yinit, C,
+                    surrogate_method_name=surrogate_method_name,
+                    surrogate_method_kwargs=surrogate_method_kwargs,
+                    surrogate_return_mean_variance=optimize_mean_variance,
+                    logger=logger, file_path=file_path, mesh=mesh,
+                    info=ph, surrogate_refit=surrogate_refit,
+                    telemetry=telemetry,
+                )
 
     if sensitivity_method_name is not None and mdl.sensitivity is None:
 
@@ -1044,12 +1046,25 @@ def epoch(
         **optimizer_kwargs_,
     )
 
+    # span discipline: a live `with` span may not be held across a
+    # generator yield (the driver would open eval spans that mis-nest
+    # under it, and interleaved problems would cross-link) — so the
+    # surrogate path, which never yields, gets a live ea_scan span,
+    # while the evaluation path records its interval after the fact
+    ea_ctx = (
+        span_scope(telemetry, "ea_scan")
+        if mdl.objective is not None
+        else contextlib.nullcontext(None)
+    )
     res = None
-    try:
-        item = next(opt_gen)
-    except StopIteration as ex:
-        res = ex.value
-    else:
+    finished = False
+    with ea_ctx:
+        try:
+            item = next(opt_gen)
+        except StopIteration as ex:
+            res = ex.value
+            finished = True
+    if not finished:
         x_gen = item
         while True:
             if mdl.objective is not None:
@@ -1073,6 +1088,14 @@ def epoch(
         dt = time.perf_counter() - t_opt0 - t_suspended
         n_gen = int(gen_index.max()) if len(gen_index) else 0
         reasons = getattr(termination, "stop_reasons", lambda: [])()
+        if mdl.objective is None and telemetry.tracer is not None:
+            # evaluation mode suspended across the loop: record the
+            # measured interval post-hoc (see the span-discipline note
+            # above); the suspended share is the driver's eval phase
+            telemetry.tracer.record_span(
+                "ea_scan", t_opt0, time.perf_counter(),
+                suspended_s=round(t_suspended, 4),
+            )
         telemetry.observe("phase_duration_seconds", dt, phase="optimize")
         telemetry.event(
             "phase", phase="optimize", duration_s=dt,
@@ -1091,11 +1114,12 @@ def epoch(
     if mdl.objective is not None:
         # dedupe resample candidates against already-evaluated points
         # (reference MOASMO.py:441-448)
-        is_duplicate = get_duplicates(best_x, x_0)
-        best_x = best_x[~is_duplicate]
-        best_y = best_y[~is_duplicate]
-        D = _as_np(crowding_distance(jnp.asarray(best_y)))
-        idxr = D.argsort()[::-1][:N_resample]
+        with span_scope(telemetry, "resample"):
+            is_duplicate = get_duplicates(best_x, x_0)
+            best_x = best_x[~is_duplicate]
+            best_y = best_y[~is_duplicate]
+            D = _as_np(crowding_distance(jnp.asarray(best_y)))
+            idxr = D.argsort()[::-1][:N_resample]
         if telemetry:
             telemetry.inc("resample_points_total", len(idxr))
             telemetry.event(

@@ -153,7 +153,16 @@ class BackgroundWriter:
                 fn, args, kwargs = item
                 if self._error is None and not self._failed:
                     try:
-                        fn(*args, **kwargs)
+                        # each persistence closure becomes one h5_write
+                        # tracing span on the writer's own track
+                        # (duck-typed: external telemetry objects
+                        # without .span are simply not traced)
+                        span = getattr(self.telemetry, "span", None)
+                        if self.telemetry and span is not None:
+                            with span("h5_write"):
+                                fn(*args, **kwargs)
+                        else:
+                            fn(*args, **kwargs)
                     except BaseException as e:  # surfaced on driver thread
                         self._error = e
             finally:

@@ -25,8 +25,11 @@ persistence rides the pipeline's ordered `BackgroundWriter`
 
 from __future__ import annotations
 
+import contextlib
 import itertools
+import json
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -45,9 +48,20 @@ from dmosopt_tpu.parallel.evaluator import (
 )
 from dmosopt_tpu.parallel.pipeline import BackgroundWriter
 from dmosopt_tpu.strategy import DistOptStrategy
-from dmosopt_tpu.telemetry import Telemetry, create_telemetry
+from dmosopt_tpu.telemetry import Telemetry, create_telemetry, span_scope
+from dmosopt_tpu.utils import json_default
 
 logger = logging.getLogger(__name__)
+
+# per-epoch attributed-cost keys the batched core leaves in a
+# strategy's stats dict (dmosopt_tpu.tenants cost attribution); the
+# service pops them after each epoch into the tenant's cumulative
+# handle costs
+_COST_KEYS = (
+    ("cost_fit_seconds", "fit"),
+    ("cost_ea_seconds", "ea"),
+    ("cost_compile_seconds", "compile"),
+)
 
 
 @dataclass
@@ -69,6 +83,12 @@ class TenantHandle:
         self.opt_id = opt_id
         self.done = False
         self.error: Optional[BaseException] = None
+        # cumulative attributed cost of this tenant's share of its
+        # buckets' compiled programs (dmosopt_tpu.tenants attribution;
+        # zero for tenants that only rode the sequential path)
+        self.cost_seconds: Dict[str, float] = {
+            "fit": 0.0, "ea": 0.0, "compile": 0.0,
+        }
         self._updates: deque = deque()
         self._latest: Optional[FrontUpdate] = None
         self._lock = threading.Lock()
@@ -133,17 +153,31 @@ class OptimizationService:
         min_bucket: int = 2,
         telemetry=None,
         logger=logger,
+        status_path: Optional[str] = None,
     ):
         self.min_bucket = int(min_bucket)
         self.telemetry = create_telemetry(telemetry)
         self._owns_telemetry = not isinstance(telemetry, Telemetry)
         self.logger = logger
+        self.status_path = status_path
         self._pending: List[_Tenant] = []
         self._active: Dict[int, _Tenant] = {}
         self._ids = itertools.count()
         self._writer: Optional[BackgroundWriter] = None
         self._lock = threading.Lock()
         self._closed = False
+        # introspection state: step/phase timings, the best
+        # per-tenant-normalized step wall (the throughput baseline),
+        # and retired-tenant bookkeeping. `_retired` keeps only the
+        # most RECENT retirees (a long-lived service retires tenants
+        # forever; an unbounded list would make every status snapshot
+        # O(lifetime tenants)) while `_retired_counts` keeps the
+        # accurate cumulative totals per state.
+        self._steps_run = 0
+        self._last_step: Dict[str, Any] = {}
+        self._best_step_s_per_tenant: Optional[float] = None
+        self._retired: deque = deque(maxlen=256)
+        self._retired_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------ submit
 
@@ -233,9 +267,20 @@ class OptimizationService:
     def _admit_pending(self):
         with self._lock:
             admitted, self._pending = self._pending, []
-        for t in admitted:
-            self._active[t.handle.tenant_id] = t
+            for t in admitted:
+                self._active[t.handle.tenant_id] = t
         return len(admitted)
+
+    def _retire(self, tenant: _Tenant, state: str):
+        """Record one tenant leaving the active set: bounded recent
+        snapshot + cumulative per-state count, under the lock so a
+        monitoring thread's `introspect()` never races the mutation."""
+        with self._lock:
+            self._active.pop(tenant.handle.tenant_id, None)
+            self._retired.append(self._retire_summary(tenant, state))
+            self._retired_counts[state] = (
+                self._retired_counts.get(state, 0) + 1
+            )
 
     def _gather_tenant_rounds(self, tenant: _Tenant):
         """Pop the tenant's pending requests into single-problem
@@ -255,15 +300,16 @@ class OptimizationService:
         across tenants), then fold each tenant's results in submission
         order."""
         inflight = []
-        for t in self._active.values():
-            task_args, task_reqs = self._gather_tenant_rounds(t)
-            if not task_args:
-                continue
-            if hasattr(t.evaluator, "submit_batch"):
-                handle = t.evaluator.submit_batch(task_args)
-            else:
-                handle = None
-            inflight.append((t, handle, task_args, task_reqs))
+        with span_scope(self.telemetry, "eval_dispatch"):
+            for t in self._active.values():
+                task_args, task_reqs = self._gather_tenant_rounds(t)
+                if not task_args:
+                    continue
+                if hasattr(t.evaluator, "submit_batch"):
+                    handle = t.evaluator.submit_batch(task_args)
+                else:
+                    handle = None
+                inflight.append((t, handle, task_args, task_reqs))
 
         n_evals = 0
         for t, handle, task_args, task_reqs in inflight:
@@ -303,7 +349,7 @@ class OptimizationService:
     def _fail_tenant(self, tenant: _Tenant, error: BaseException):
         tenant.handle.error = error
         tenant.handle.done = True
-        self._active.pop(tenant.handle.tenant_id, None)
+        self._retire(tenant, "failed")
         if tenant.owns_evaluator and hasattr(tenant.evaluator, "close"):
             try:
                 tenant.evaluator.close()
@@ -342,60 +388,114 @@ class OptimizationService:
                 self.logger,
             )
 
+    @contextlib.contextmanager
+    def _step_phase(self, phases: Dict[str, float], name: str):
+        """Time one sub-phase of `step()` into `phases` and
+        `service_step_seconds{phase=}`. Tracing spans are composed at
+        the call sites via `span_scope` so the span names stay
+        string-literal-scannable by graftlint's metrics-catalog rule."""
+        tel = self.telemetry
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            phases[name] = dt
+            if tel:
+                tel.observe("service_step_seconds", dt, phase=name)
+
+    def _absorb_tenant_costs(self, tenant: _Tenant):
+        """Move the epoch's attributed-cost keys from the strategy's
+        stats into the handle's cumulative totals. Popping (not
+        reading) matters: the stats dict persists across epochs, and a
+        tenant that rides a bucket one epoch and the sequential path
+        the next would otherwise re-count the stale share."""
+        for key, phase in _COST_KEYS:
+            v = tenant.strat.stats.pop(key, None)
+            if v is not None:
+                tenant.handle.cost_seconds[phase] += float(v)
+
     def step(self) -> int:
         """One epoch boundary: admit pending tenants, evaluate pending
         requests (initial designs and resample batches), advance every
         active tenant one epoch — bucket-mates batched — and stream
-        fronts. Returns the number of tenants advanced."""
+        fronts. Returns the number of tenants advanced.
+
+        The step is decomposed into four timed phases — ``admit`` /
+        ``eval`` / ``fit`` (the batched bucket advance, surrogate fit +
+        inner EA) / ``fold`` (result installation + front streaming) —
+        each observed into ``service_step_seconds{phase=}`` and, with
+        tracing enabled, nested under one ``epoch`` span."""
         if self._closed:
             raise RuntimeError("service is closed")
         from dmosopt_tpu.tenants import initialize_epochs_batched
         from dmosopt_tpu.datatypes import StrategyState
 
         t0 = time.perf_counter()
-        self._admit_pending()
-        if not self._active:
-            return 0
-        self._drain_evaluations()
+        phases: Dict[str, float] = {}
+        n_advanced = 0
+        with span_scope(self.telemetry, "epoch", step=self._steps_run):
+            with self._step_phase(phases, "admit"), span_scope(
+                self.telemetry, "admit"
+            ):
+                self._admit_pending()
+            if not self._active:
+                self._finish_step(t0, phases, 0)
+                return 0
+            with self._step_phase(phases, "eval"), span_scope(
+                self.telemetry, "eval_drain"
+            ):
+                self._drain_evaluations()
 
-        strategies = {
-            tid: t.strat for tid, t in self._active.items()
-        }
-        epochs = {tid: t.epochs_run for tid, t in self._active.items()}
-        initialize_epochs_batched(
-            strategies, epochs, min_bucket=self.min_bucket,
-            telemetry=self.telemetry, logger=self.logger,
-        )
+            strategies = {
+                tid: t.strat for tid, t in self._active.items()
+            }
+            epochs = {tid: t.epochs_run for tid, t in self._active.items()}
+            # no own span: the bucket runs open their gp_fit / ea_scan
+            # spans (with tenant_cost children) directly under `epoch`
+            with self._step_phase(phases, "fit"):
+                initialize_epochs_batched(
+                    strategies, epochs, min_bucket=self.min_bucket,
+                    telemetry=self.telemetry, logger=self.logger,
+                )
 
-        finished = []
-        for tid, t in list(self._active.items()):
-            try:
-                resample = (t.epochs_run + 1) < t.n_epochs
-                state, _res, _evals = t.strat.update_epoch(resample=resample)
-                if state != StrategyState.CompletedEpoch:
-                    raise RuntimeError(
-                        f"tenant {t.handle.opt_id!r}: epoch did not "
-                        f"complete in one update (state {state}); the "
-                        f"service requires surrogate-mode tenants"
-                    )
-                epoch = t.epochs_run
-                t.epochs_run += 1
-                self._stream_front(t, epoch)
-            except Exception as e:
-                self._fail_tenant(t, e)
-                continue
-            if t.epochs_run >= t.n_epochs:
-                finished.append(tid)
+            with self._step_phase(phases, "fold"), span_scope(
+                self.telemetry, "fold"
+            ):
+                finished = []
+                for tid, t in list(self._active.items()):
+                    try:
+                        resample = (t.epochs_run + 1) < t.n_epochs
+                        state, _res, _evals = t.strat.update_epoch(
+                            resample=resample
+                        )
+                        if state != StrategyState.CompletedEpoch:
+                            raise RuntimeError(
+                                f"tenant {t.handle.opt_id!r}: epoch did not "
+                                f"complete in one update (state {state}); the "
+                                f"service requires surrogate-mode tenants"
+                            )
+                        epoch = t.epochs_run
+                        t.epochs_run += 1
+                        self._absorb_tenant_costs(t)
+                        self._stream_front(t, epoch)
+                    except Exception as e:
+                        self._fail_tenant(t, e)
+                        continue
+                    if t.epochs_run >= t.n_epochs:
+                        finished.append(tid)
 
-        for tid in finished:
-            t = self._active.pop(tid)
-            t.handle.done = True
-            if t.owns_evaluator and hasattr(t.evaluator, "close"):
-                t.evaluator.close()
-            if self.telemetry:
-                self.telemetry.inc("tenants_completed_total")
-        if self._writer is not None:
-            self._writer.flush()
+                for tid in finished:
+                    t = self._active[tid]
+                    t.handle.done = True
+                    self._retire(t, "completed")
+                    if t.owns_evaluator and hasattr(t.evaluator, "close"):
+                        t.evaluator.close()
+                    if self.telemetry:
+                        self.telemetry.inc("tenants_completed_total")
+            if self._writer is not None:
+                self._writer.flush()
+            n_advanced = len(strategies)
         if self.telemetry:
             self.telemetry.inc("service_epochs_total")
             self.telemetry.gauge("tenants_active", len(self._active))
@@ -404,7 +504,169 @@ class OptimizationService:
                 time.perf_counter() - t0,
                 phase="service_step",
             )
-        return len(strategies)
+        self._finish_step(t0, phases, n_advanced)
+        return n_advanced
+
+    def _finish_step(self, t0: float, phases: Dict[str, float], n_advanced: int):
+        """Step-end bookkeeping: the whole-step timing series, the
+        per-tenant-normalized throughput baseline, and the status-file
+        snapshot."""
+        wall = time.perf_counter() - t0
+        if self.telemetry:
+            self.telemetry.observe("service_step_seconds", wall, phase="step")
+        self._steps_run += 1
+        self._last_step = {
+            "wall_s": wall,
+            "n_advanced": n_advanced,
+            "phases": {k: round(v, 6) for k, v in phases.items()},
+        }
+        if n_advanced > 0:
+            per_tenant = wall / n_advanced
+            self._last_step["wall_s_per_tenant"] = per_tenant
+            if (
+                self._best_step_s_per_tenant is None
+                or per_tenant < self._best_step_s_per_tenant
+            ):
+                self._best_step_s_per_tenant = per_tenant
+        self._write_status()
+
+    # ------------------------------------------------------ introspection
+
+    @staticmethod
+    def _tenant_snapshot(t: _Tenant, state: str) -> Dict[str, Any]:
+        cost = dict(t.handle.cost_seconds)
+        snap = {
+            "opt_id": t.handle.opt_id,
+            "tenant_id": t.handle.tenant_id,
+            "state": state,
+            "epoch": t.epochs_run,
+            "n_epochs": t.n_epochs,
+            "cost_seconds": {k: round(v, 6) for k, v in cost.items()},
+        }
+        # attributed throughput: the tenant's generation budget over its
+        # attributed EA seconds per epoch — only meaningful once a
+        # batched epoch has landed a cost share
+        if cost.get("ea", 0.0) > 0 and t.epochs_run > 0:
+            snap["gens_per_sec"] = round(
+                t.strat.num_generations * t.epochs_run / cost["ea"], 3
+            )
+        return snap
+
+    def _retire_summary(self, t: _Tenant, state: str) -> Dict[str, Any]:
+        return self._tenant_snapshot(t, state)
+
+    def _throughput_check(self) -> Dict[str, Any]:
+        """Loadavg-normalized step-throughput regression check — the
+        BENCH_r04/r05 trap detected at runtime: a contended host
+        inflates wall clocks 3-9x, so a slow step on a loaded machine
+        reads ``host_contended`` (re-measure idle before believing it),
+        while a slow step on an idle machine is a genuine
+        ``regression_suspect``. Baseline = the best per-tenant step
+        wall this service has seen."""
+        try:
+            load1 = os.getloadavg()[0]
+        except OSError:  # pragma: no cover - platform without loadavg
+            load1 = None
+        ncpu = os.cpu_count() or 1
+        last = self._last_step.get("wall_s_per_tenant")
+        best = self._best_step_s_per_tenant
+        out: Dict[str, Any] = {
+            "last_step_s_per_tenant": round(last, 6) if last else last,
+            "best_step_s_per_tenant": round(best, 6) if best else best,
+            "loadavg_1m": round(load1, 2) if load1 is not None else None,
+            "cpu_count": ncpu,
+            "load_ratio": (
+                round(load1 / ncpu, 3) if load1 is not None else None
+            ),
+        }
+        if last is None or best is None:
+            out["status"] = "no_data"
+        elif last <= 2.0 * best:
+            out["status"] = "ok"
+        elif load1 is not None and load1 > 1.5 * ncpu:
+            out["status"] = "host_contended"
+            out["note"] = (
+                "step wall regressed but the host is contended "
+                "(1-min loadavg above 1.5x cores) — walls can be 3-9x "
+                "inflated; re-measure idle before trusting this"
+            )
+        else:
+            out["status"] = "regression_suspect"
+            out["note"] = (
+                "step wall regressed more than 2x against this "
+                "service's best on an apparently idle host"
+            )
+        return out
+
+    def introspect(self) -> Dict[str, Any]:
+        """Live service snapshot: every tenant's state/epoch/attributed
+        cost, queue depths (pending submissions, writer backlog),
+        telemetry series-overflow state, the last step's per-phase
+        seconds, and the loadavg-normalized throughput check. Plain
+        JSON-able dict — also written to ``status_path`` after every
+        step and rendered by the ``status`` CLI subcommand. Safe to
+        call from a monitoring thread while another thread steps.
+        ``tenant_counts`` is cumulative and exact; the ``tenants`` list
+        shows active/pending tenants plus the most recent retirees (the
+        `_retired` bound), not the full lifetime history."""
+        with self._lock:
+            pending_tenants = list(self._pending)
+            active_tenants = list(self._active.values())
+            retired = list(self._retired)
+            counts = dict(self._retired_counts)
+        tenants = [
+            self._tenant_snapshot(t, "active") for t in active_tenants
+        ]
+        tenants.extend(
+            self._tenant_snapshot(t, "pending") for t in pending_tenants
+        )
+        tenants.extend(retired)
+        if active_tenants:
+            counts["active"] = len(active_tenants)
+        if pending_tenants:
+            counts["pending"] = len(pending_tenants)
+        overflow = 0.0
+        if self.telemetry:
+            overflow = self.telemetry.registry.counter_value(
+                "telemetry_series_overflow_total"
+            )
+        snap = {
+            "ts": time.time(),
+            "closed": self._closed,
+            "steps": self._steps_run,
+            "tenant_counts": counts,
+            "tenants": sorted(tenants, key=lambda t: t["tenant_id"]),
+            "queue_depths": {
+                "pending_submissions": len(pending_tenants),
+                "writer_backlog": (
+                    self._writer.queue_depth if self._writer is not None else 0
+                ),
+            },
+            "series_overflow_total": overflow,
+            "last_step": dict(self._last_step),
+            "throughput": self._throughput_check(),
+        }
+        if self.telemetry and self.telemetry.tracer is not None:
+            snap["trace_path"] = self.telemetry.tracer.path
+        return snap
+
+    def _write_status(self):
+        """Atomically publish the introspection snapshot to
+        ``status_path`` (tmp + rename, so a concurrent `status` CLI
+        reader never sees a torn file). Best-effort: a failing status
+        write must never take the service down."""
+        if self.status_path is None:
+            return
+        try:
+            tmp = self.status_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(self.introspect(), fh, default=json_default)
+            os.replace(tmp, self.status_path)
+        except OSError:
+            self.logger.warning(
+                f"status snapshot write to {self.status_path!r} failed",
+                exc_info=True,
+            )
 
     def run(self, max_steps: Optional[int] = None) -> int:
         """Step until every submitted tenant completes (or `max_steps`);
@@ -423,8 +685,11 @@ class OptimizationService:
         if self._closed:
             return
         self._closed = True
-        for t in list(self._active.values()) + list(self._pending):
+        with self._lock:
+            to_cancel = list(self._active.values()) + list(self._pending)
+        for t in to_cancel:
             t.handle.done = True
+            self._retire(t, "cancelled")
             if t.epochs_run < t.n_epochs and t.handle.error is None:
                 # an interim (or absent) front must not read as a
                 # completed optimization: result() re-raises this, while
@@ -440,12 +705,15 @@ class OptimizationService:
                     self.logger.exception(
                         f"tenant {t.handle.opt_id!r}: evaluator close failed"
                     )
-        self._active.clear()
-        self._pending = []
+        with self._lock:
+            self._active.clear()
+            self._pending = []
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+        self._write_status()
         if self.telemetry is not None and self._owns_telemetry:
+            # exports the Chrome trace when a trace_path is configured
             self.telemetry.close()
 
     def __enter__(self):

@@ -392,6 +392,39 @@ def save_telemetry_to_h5(opt_id, epoch, summary, fpath, logger=None):
         _json_attr(grp, str(int(epoch)), summary)
 
 
+def save_spans_to_h5(opt_id, epoch, spans, fpath, logger=None):
+    """Append one epoch's closed tracing spans (list of `Span.to_dict`
+    dicts) under `/{opt_id}/telemetry_spans/{epoch}` as one JSON string
+    dataset — beside the epoch summaries, so a stored run's timeline
+    survives resume. A dataset, not an attribute: an evaluation-mode
+    epoch can close hundreds of eval spans, past the HDF5 attribute
+    size limit."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        grp = h5_get_group(h5, f"{opt_id}/telemetry_spans")
+        key = str(int(epoch))
+        if key in grp:
+            del grp[key]
+        grp.create_dataset(key, data=json.dumps(spans, default=json_default))
+
+
+def load_spans_from_h5(fpath, opt_id) -> Dict[int, list]:
+    """All stored per-epoch span lists, `{epoch: [span dicts]}` (empty
+    when the run predates span tracing or had telemetry disabled)."""
+    h5py = _require_h5py()
+    out: Dict[int, list] = {}
+    with h5py.File(fpath, "r") as h5:
+        grp = h5.get(f"{opt_id}/telemetry_spans")
+        if grp is None:
+            return out
+        for key in grp:
+            raw = grp[key][()]
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            out[int(key)] = json.loads(raw)
+    return dict(sorted(out.items()))
+
+
 def load_telemetry_from_h5(fpath, opt_id) -> Dict[int, Dict]:
     """All stored epoch telemetry summaries, `{epoch: summary}` (empty
     dict when the run predates the telemetry group or had it disabled)."""

@@ -28,6 +28,11 @@ from typing import Any, Dict, Optional, Sequence, Union
 
 from dmosopt_tpu.telemetry.events import Event, EventLog, jsonable, read_jsonl  # noqa: F401
 from dmosopt_tpu.telemetry.registry import MetricsRegistry  # noqa: F401
+from dmosopt_tpu.telemetry.tracing import (  # noqa: F401
+    Span,
+    Tracer,
+    validate_chrome_trace,
+)
 
 # Telemetry summaries merge these aggregates across a run's eval events
 # (the rest of `eval_time_stats` — std/median — does not merge exactly).
@@ -54,6 +59,8 @@ class Telemetry:
         profile_epochs: Optional[Sequence[int]] = None,
         histogram_buckets: Optional[Dict[str, Sequence[float]]] = None,
         label_series_limit: Optional[int] = 512,
+        trace_path: Optional[str] = None,
+        trace_max_spans: int = 16384,
     ):
         self.enabled = bool(enabled)
         self.registry = MetricsRegistry(
@@ -63,6 +70,14 @@ class Telemetry:
         self.log = EventLog(
             ring_size=ring_size,
             jsonl_path=jsonl_path if self.enabled else None,
+        )
+        # spans are always collected on an enabled instance (they feed
+        # per-epoch persistence and service introspection); `trace_path`
+        # additionally exports them as Chrome trace-event JSON on close
+        self.tracer: Optional[Tracer] = (
+            Tracer(path=trace_path, max_spans=trace_max_spans)
+            if self.enabled
+            else None
         )
         self.profile_dir = profile_dir
         self.profile_epochs = (
@@ -123,6 +138,16 @@ class Telemetry:
         if ev.epoch is not None:
             self._events_by_epoch.setdefault(ev.epoch, []).append(ev)
         return ev
+
+    # ------------------------------------------------------------- spans
+
+    def span(self, name: str, **labels):
+        """Open one nested host-side tracing span (see
+        `dmosopt_tpu.telemetry.tracing`). Disabled instances return a
+        null context yielding None, so call sites stay one-liners."""
+        if self.enabled and self.tracer is not None:
+            return self.tracer.span(name, **labels)
+        return contextlib.nullcontext(None)
 
     @contextlib.contextmanager
     def phase(self, phase: str, epoch: Optional[int] = None, **fields):
@@ -231,6 +256,11 @@ class Telemetry:
         return jsonable(summary)
 
     def close(self):
+        if self.tracer is not None and self.tracer.path is not None:
+            try:
+                self.tracer.export()
+            except OSError:
+                pass  # an unwritable trace path must not mask run teardown
         self.log.close()
 
 
@@ -241,6 +271,14 @@ def phase_scope(tel: Optional["Telemetry"], phase: str, epoch=None, **fields):
     if tel:
         return tel.phase(phase, epoch=epoch, **fields)
     return contextlib.nullcontext({})
+
+
+def span_scope(tel: Optional["Telemetry"], name: str, **labels):
+    """`tel.span(...)` when telemetry is live, else a no-op context
+    yielding None — the span analogue of `phase_scope`."""
+    if tel:
+        return tel.span(name, **labels)
+    return contextlib.nullcontext(None)
 
 
 def record_device_memory(tel: Optional["Telemetry"]):

@@ -106,7 +106,18 @@ class EventLog:
                 self._fh.write(
                     json.dumps(ev.to_dict(), default=json_default) + "\n"
                 )
+                if kind == "phase":
+                    # a phase close is the natural durability boundary:
+                    # flush so a killed run's sink keeps everything up
+                    # to its last completed phase, independent of the
+                    # file object's buffering mode
+                    self._fh.flush()
         return ev
+
+    def flush(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
 
     def records(
         self, kind: Optional[str] = None, epoch: Optional[int] = None

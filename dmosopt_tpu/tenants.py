@@ -38,6 +38,7 @@ path exactly; only batched-kernel reduction order differs.
 
 from __future__ import annotations
 
+import hashlib
 import time
 from dataclasses import dataclass, field
 from types import SimpleNamespace
@@ -46,6 +47,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from dmosopt_tpu.telemetry import span_scope
 
 from dmosopt_tpu.config import resolve, default_optimizers
 from dmosopt_tpu.models import Model
@@ -340,11 +343,38 @@ def _slice_tree(tree, i):
 # trace+compile per bucket per epoch at T=64. FIFO-bounded: a
 # long-lived service whose bucket populations fluctuate (a new (sig, T)
 # per join/finish) must not pin compiled programs forever.
-_PROGRAM_CACHE: Dict[Tuple, Any] = {}
+#
+# Each entry is a `_BucketProgram`: the traced function plus explicitly
+# AOT-compiled executables keyed by the argument shapes/dtypes. Going
+# through `fn.lower(...).compile()` instead of jit's implicit dispatch
+# makes every compile OBSERVABLE — wall seconds, XLA cost-analysis
+# FLOPs/bytes, and (the retrace detector) a warning event whenever a
+# (signature, T) key that already had an executable compiles again:
+# shape drift (a training cap crossing a `_bucket_size` boundary, a
+# changed generation budget) is exactly the silent multi-second stall
+# the cache exists to prevent.
+_PROGRAM_CACHE: Dict[Tuple, "_BucketProgram"] = {}
 _PROGRAM_CACHE_MAX = 64
 
 
-def _bucket_program(sig: Tuple, optimizer, kernel: str, T: int):
+class _BucketProgram:
+    __slots__ = ("fn", "executables")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.executables: Dict[Tuple, Any] = {}
+
+
+def _sig_label(sig: Tuple) -> str:
+    """Low-cardinality, human-greppable label for a bucket signature:
+    the shape prefix plus a short hash of the full static config."""
+    digest = hashlib.sha256(repr(sig).encode()).hexdigest()[:8]
+    if len(sig) >= 4:
+        return f"{sig[0]}_d{sig[1]}_o{sig[2]}_p{sig[3]}_{digest}"
+    return digest
+
+
+def _bucket_program(sig: Tuple, optimizer, kernel: str, T: int) -> "_BucketProgram":
     key = (sig, T)
     prog = _PROGRAM_CACHE.get(key)
     if prog is not None:
@@ -382,8 +412,68 @@ def _bucket_program(sig: Tuple, optimizer, kernel: str, T: int):
 
         return jax.lax.scan(step, states, (keys, active))
 
-    _PROGRAM_CACHE[key] = run_chunk
-    return run_chunk
+    prog = _BucketProgram(run_chunk)
+    _PROGRAM_CACHE[key] = prog
+    return prog
+
+
+def _cost_estimates(compiled) -> Tuple[Optional[float], Optional[float]]:
+    """(flops, bytes accessed) from XLA's cost analysis of a compiled
+    executable; (None, None) where the backend does not report it."""
+    try:
+        analyses = compiled.cost_analysis()
+        if isinstance(analyses, dict):
+            analyses = [analyses]
+        flops = sum(float(a.get("flops", 0.0)) for a in analyses)
+        nbytes = sum(float(a.get("bytes accessed", 0.0)) for a in analyses)
+        return flops, nbytes
+    except Exception:
+        return None, None
+
+
+def _run_bucket_program(
+    prog: "_BucketProgram", sig: Tuple, T: int, args: Tuple,
+    telemetry=None, logger=None, label: Optional[str] = None,
+):
+    """Execute the bucket's generation-loop program for these argument
+    shapes, compiling (observably) when the shape is new. Returns
+    (result, compile_seconds)."""
+    shape_key = tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree_util.tree_leaves(args)
+    )
+    compiled = prog.executables.get(shape_key)
+    if compiled is not None:
+        return compiled(*args), 0.0
+    retrace = bool(prog.executables)
+    t0 = time.perf_counter()
+    compiled = prog.fn.lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+    prog.executables[shape_key] = compiled
+    sig_label = _sig_label(sig)
+    if telemetry:
+        flops, nbytes = _cost_estimates(compiled)
+        telemetry.inc("tenant_bucket_compiles_total", bucket=label)
+        telemetry.event(
+            "bucket_compile", bucket=label, signature=sig_label,
+            n_tenants=T, compile_s=round(compile_s, 4),
+            flops=flops, bytes_accessed=nbytes, retrace=retrace,
+        )
+        if retrace:
+            telemetry.inc("tenant_bucket_retraces_total", bucket=label)
+            telemetry.event(
+                "bucket_retrace", bucket=label, signature=sig_label,
+                n_tenants=T, compile_s=round(compile_s, 4),
+                n_shapes=len(prog.executables),
+            )
+    if retrace and logger is not None:
+        logger.warning(
+            f"tenant bucket {sig_label} (T={T}) RECOMPILED for new "
+            f"argument shapes ({len(prog.executables)} executables now "
+            f"cached, {compile_s:.2f}s) — shape drift across epochs "
+            f"re-pays the compile the program cache exists to avoid"
+        )
+    return compiled(*args), compile_s
 
 
 def run_bucket_epoch(
@@ -400,24 +490,26 @@ def run_bucket_epoch(
     pop = int(plans[0].optimizer.popsize)
     fitcfg = _fit_config(plans[0].strat)
     G_max = max(p.num_generations for p in plans)
+    label = bucket_label(n, d, pop)
 
     # ---- batched surrogate fit: common bucket capacity, masked rows
     t_fit0 = time.perf_counter()
     cap = max(_bucket_size(p.X_unit.shape[0]) for p in plans)
-    Xs, Yns, masks = [], [], []
-    for p in plans:
-        Xp, Yp, m = _pad_to_bucket(p.X_unit, p.Yn, cap=cap)
-        Xs.append(jnp.asarray(Xp, jnp.float32))
-        Yns.append(jnp.asarray(Yp, jnp.float32))
-        masks.append(jnp.asarray(m, jnp.float32))
-    keys = jnp.stack([p.fit_key for p in plans])
-    Xs, Yns, masks = jnp.stack(Xs), jnp.stack(Yns), jnp.stack(masks)
-    fit = _fit_bucket(keys, Xs, Yns, masks, fitcfg)
-    fit = fit._replace(
-        y_mean=jnp.asarray(np.stack([p.y_mean for p in plans]), jnp.float32),
-        y_std=jnp.asarray(np.stack([p.y_std for p in plans]), jnp.float32),
-    )
-    jax.block_until_ready(fit.nmll)
+    with span_scope(telemetry, "gp_fit", bucket=label, n_tenants=T) as fit_span:
+        Xs, Yns, masks = [], [], []
+        for p in plans:
+            Xp, Yp, m = _pad_to_bucket(p.X_unit, p.Yn, cap=cap)
+            Xs.append(jnp.asarray(Xp, jnp.float32))
+            Yns.append(jnp.asarray(Yp, jnp.float32))
+            masks.append(jnp.asarray(m, jnp.float32))
+        keys = jnp.stack([p.fit_key for p in plans])
+        Xs, Yns, masks = jnp.stack(Xs), jnp.stack(Yns), jnp.stack(masks)
+        fit = _fit_bucket(keys, Xs, Yns, masks, fitcfg)
+        fit = fit._replace(
+            y_mean=jnp.asarray(np.stack([p.y_mean for p in plans]), jnp.float32),
+            y_std=jnp.asarray(np.stack([p.y_std for p in plans]), jnp.float32),
+        )
+        jax.block_until_ready(fit.nmll)
     fit_wall = time.perf_counter() - t_fit0
     # per-tenant fit summaries, the `stats["objective"]` entry the
     # sequential epoch records via mdl.get_stats() (see _gp_fit_info)
@@ -453,91 +545,149 @@ def run_bucket_epoch(
     # the freshly fitted surrogates (one batched predict), then each
     # tenant's [archive ; design] rows pad to a common masked capacity
     t_ea0 = time.perf_counter()
-    y_init = np.asarray(
-        batched_eval(jnp.asarray(np.stack([p.x_init for p in plans])))
-    ).astype(np.float32)
-    run_chunk = _bucket_program(sig, plans[0].optimizer, kernel, T)
-    n_cat = [p.x0.shape[0] + p.x_init.shape[0] for p in plans]
-    P_init = max(n_cat)
-    Xcat = np.zeros((T, P_init, n), np.float32)
-    Ycat = np.zeros((T, P_init, d), np.float32)
-    Mcat = np.zeros((T, P_init), bool)
-    for t, p in enumerate(plans):
-        xc = np.vstack([p.x0, p.x_init])
-        yc = np.vstack([p.y0, y_init[t]])
-        Xcat[t, : n_cat[t]] = xc
-        Ycat[t, : n_cat[t]] = yc
-        Mcat[t, : n_cat[t]] = True
+    with span_scope(telemetry, "ea_scan", bucket=label, n_tenants=T) as ea_span:
+        y_init = np.asarray(
+            batched_eval(jnp.asarray(np.stack([p.x_init for p in plans])))
+        ).astype(np.float32)
+        prog = _bucket_program(sig, plans[0].optimizer, kernel, T)
+        n_cat = [p.x0.shape[0] + p.x_init.shape[0] for p in plans]
+        P_init = max(n_cat)
+        Xcat = np.zeros((T, P_init, n), np.float32)
+        Ycat = np.zeros((T, P_init, d), np.float32)
+        Mcat = np.zeros((T, P_init), bool)
+        for t, p in enumerate(plans):
+            xc = np.vstack([p.x0, p.x_init])
+            yc = np.vstack([p.y0, y_init[t]])
+            Xcat[t, : n_cat[t]] = xc
+            Ycat[t, : n_cat[t]] = yc
+            Mcat[t, : n_cat[t]] = True
 
-    optimizer = plans[0].optimizer  # bucket tracer: same static config
+        optimizer = plans[0].optimizer  # bucket tracer: same static config
 
-    def init_one(k, x, y, b, m):
-        return optimizer.initialize_state(k, x, y, b, mask=m)
+        def init_one(k, x, y, b, m):
+            return optimizer.initialize_state(k, x, y, b, mask=m)
 
-    states = jax.vmap(init_one)(
-        jnp.stack([p.init_key for p in plans]),
-        jnp.asarray(Xcat), jnp.asarray(Ycat), bounds, jnp.asarray(Mcat),
-    )
-
-    # ---- per-tenant generation keys: split(loop_key, G_t) exactly as
-    # the sequential scan would, zero-padded to G_max for late phases
-    keys = np.zeros((T, G_max, 2), np.uint32)
-    active = np.zeros((G_max, T), bool)
-    for t, p in enumerate(plans):
-        kt = jax.random.split(p.loop_key, p.num_generations)
-        keys[t, : p.num_generations] = np.asarray(
-            jax.random.key_data(kt)
-            if jnp.issubdtype(kt.dtype, jax.dtypes.prng_key)
-            else kt
+        states = jax.vmap(init_one)(
+            jnp.stack([p.init_key for p in plans]),
+            jnp.asarray(Xcat), jnp.asarray(Ycat), bounds, jnp.asarray(Mcat),
         )
-        active[: p.num_generations, t] = True
-    keys_scan = jnp.asarray(np.swapaxes(keys, 0, 1))  # (G, T, 2)
-    active_scan = jnp.asarray(active)
 
-    states, (x_traj, y_traj) = run_chunk(
-        fit, xlb, xrg, states, keys_scan, active_scan
-    )
-    x_traj = np.asarray(x_traj)  # (G, T, noff, n)
-    y_traj = np.asarray(y_traj)
-    # one host materialization of the final states; per-tenant slices
-    # below are numpy views, not T x n_leaves device dispatches
-    states = jax.tree_util.tree_map(np.asarray, states)
+        # ---- per-tenant generation keys: split(loop_key, G_t) exactly
+        # as the sequential scan would, zero-padded to G_max for late
+        # phases
+        keys = np.zeros((T, G_max, 2), np.uint32)
+        active = np.zeros((G_max, T), bool)
+        for t, p in enumerate(plans):
+            kt = jax.random.split(p.loop_key, p.num_generations)
+            keys[t, : p.num_generations] = np.asarray(
+                jax.random.key_data(kt)
+                if jnp.issubdtype(kt.dtype, jax.dtypes.prng_key)
+                else kt
+            )
+            active[: p.num_generations, t] = True
+        keys_scan = jnp.asarray(np.swapaxes(keys, 0, 1))  # (G, T, 2)
+        active_scan = jnp.asarray(active)
+
+        (states, (x_traj, y_traj)), compile_s = _run_bucket_program(
+            prog, sig, T,
+            (fit, xlb, xrg, states, keys_scan, active_scan),
+            telemetry=telemetry, logger=logger, label=label,
+        )
+        x_traj = np.asarray(x_traj)  # (G, T, noff, n)
+        y_traj = np.asarray(y_traj)
+        # one host materialization of the final states; per-tenant slices
+        # below are numpy views, not T x n_leaves device dispatches
+        states = jax.tree_util.tree_map(np.asarray, states)
     ea_wall = time.perf_counter() - t_ea0
     noff = x_traj.shape[2]
 
+    # ---- per-tenant cost attribution: the bucket's measured walls,
+    # split across its tenants so the shares SUM to the walls exactly.
+    # Fit weights are masked-row-aware (each tenant's real training
+    # rows, not the common padded cap); EA and compile weights are
+    # active-mask-weighted (each tenant's generation budget — staggered
+    # late joiners ride frozen rows for the rest). The per-tenant
+    # shares land in `stats` (-> strategy stats -> `get_stats`, where
+    # the 16-problem guard aggregates them to means), in the
+    # `tenant_cost_seconds` counter, and as `tenant_cost` child spans
+    # tiling the bucket's gp_fit / ea_scan spans.
+    row_total = float(sum(p.X_unit.shape[0] for p in plans)) or float(T)
+    gen_total = float(sum(p.num_generations for p in plans)) or float(T)
+    ea_exec = max(ea_wall - compile_s, 0.0)
+    costs = []
+    for p in plans:
+        w_fit = p.X_unit.shape[0] / row_total
+        w_gen = p.num_generations / gen_total
+        costs.append(
+            {
+                "fit": fit_wall * w_fit,
+                "ea": ea_exec * w_gen,
+                "compile": compile_s * w_gen,
+            }
+        )
+    for p, c in zip(plans, costs):
+        p.stats["cost_fit_seconds"] = c["fit"]
+        p.stats["cost_ea_seconds"] = c["ea"]
+        p.stats["cost_compile_seconds"] = c["compile"]
+    if telemetry:
+        for p, c in zip(plans, costs):
+            for phase, v in c.items():
+                telemetry.inc(
+                    "tenant_cost_seconds", v, tenant=str(p.pid), phase=phase
+                )
+        tracer = telemetry.tracer
+        if tracer is not None:
+            for parent, phase in ((fit_span, "fit"), (ea_span, "ea")):
+                if parent is None or parent.t_end is None:
+                    continue
+                # the shares sum to fit_wall/ea_wall, clocked over a
+                # slightly LARGER interval than the span itself — clamp
+                # both ends so the tiling never overruns the parent
+                # into a negative-duration slice
+                t_cursor = parent.t_start
+                for p, c in zip(plans, costs):
+                    share = c[phase] + (c["compile"] if phase == "ea" else 0.0)
+                    t0 = min(t_cursor, parent.t_end)
+                    t_cursor += share
+                    tracer.record_span(
+                        "tenant_cost", t0, min(t_cursor, parent.t_end),
+                        parent=parent, tenant=str(p.pid), phase=phase,
+                        bucket=label, seconds=round(share, 6),
+                    )
+
     # ---- per-tenant host tail: flatten trajectories, dedupe, resample
     results = {}
-    for t, p in enumerate(plans):
-        G_t = p.num_generations
-        x_dev = x_traj[:G_t, t].reshape(-1, n)
-        y_dev = y_traj[:G_t, t].reshape(-1, d)
-        gen_index = np.concatenate(
-            [np.zeros((n_cat[t],), np.uint32)]
-            + [
-                np.full((noff,), g + 1, dtype=np.uint32)
-                for g in range(G_t)
-            ]
-        )
-        x_all = np.vstack([Xcat[t, : n_cat[t]], x_dev])
-        y_all = np.vstack([Ycat[t, : n_cat[t]], y_dev])
+    with span_scope(telemetry, "resample", bucket=label, n_tenants=T):
+        for t, p in enumerate(plans):
+            G_t = p.num_generations
+            x_dev = x_traj[:G_t, t].reshape(-1, n)
+            y_dev = y_traj[:G_t, t].reshape(-1, d)
+            gen_index = np.concatenate(
+                [np.zeros((n_cat[t],), np.uint32)]
+                + [
+                    np.full((noff,), g + 1, dtype=np.uint32)
+                    for g in range(G_t)
+                ]
+            )
+            x_all = np.vstack([Xcat[t, : n_cat[t]], x_dev])
+            y_all = np.vstack([Ycat[t, : n_cat[t]], y_dev])
 
-        p.optimizer.state = _slice_tree(states, t)
-        best_x, best_y = (
-            np.asarray(a) for a in p.optimizer.population_objectives
-        )
-        is_duplicate = get_duplicates(best_x, p.x0)
-        best_x = best_x[~is_duplicate]
-        best_y = best_y[~is_duplicate]
-        D = np.asarray(crowding_distance(jnp.asarray(best_y)))
-        idxr = D.argsort()[::-1][: p.n_resample]
-        results[p.pid] = {
-            "x_resample": best_x[idxr, :], "y_pred": best_y[idxr, :],
-            "gen_index": gen_index, "x_sm": x_all, "y_sm": y_all,
-            "optimizer": p.optimizer, "stats": dict(p.stats),
-        }
+            p.optimizer.state = _slice_tree(states, t)
+            best_x, best_y = (
+                np.asarray(a) for a in p.optimizer.population_objectives
+            )
+            is_duplicate = get_duplicates(best_x, p.x0)
+            best_x = best_x[~is_duplicate]
+            best_y = best_y[~is_duplicate]
+            D = np.asarray(crowding_distance(jnp.asarray(best_y)))
+            idxr = D.argsort()[::-1][: p.n_resample]
+            results[p.pid] = {
+                "x_resample": best_x[idxr, :], "y_pred": best_y[idxr, :],
+                "gen_index": gen_index, "x_sm": x_all, "y_sm": y_all,
+                "optimizer": p.optimizer, "stats": dict(p.stats),
+            }
 
     if telemetry:
-        label = bucket_label(n, d, pop)
         telemetry.inc("tenant_bucket_epochs_total", bucket=label)
         telemetry.inc("tenants_batched_total", T)
         telemetry.gauge("tenant_bucket_size", T, bucket=label)

@@ -616,6 +616,44 @@ def test_metrics_catalog_fixture(tmp_path):
     assert len(live) == 1 and "undocumented_gauge" in live[0].message
 
 
+def test_metrics_catalog_scans_span_names(tmp_path):
+    """ISSUE 9 extension: span names opened via `.span(` /
+    `.record_span(` / `span_scope(tel, ...)` are held to the same
+    catalog — an undocumented span is a red finding."""
+    files = {
+        "docs/observability.md": (
+            "Spans: `epoch` and `gp_fit` are cataloged.\n"
+        ),
+        "dmosopt_tpu/a.py": """
+            from dmosopt_tpu.telemetry import span_scope
+
+            def traced(tel, tracer):
+                with tel.span("epoch"):
+                    pass
+                with span_scope(tel, "gp_fit"):
+                    pass
+                with tel.span("mystery_span"):
+                    pass
+                tracer.record_span("orphan_span", 0.0, 1.0)
+                with span_scope(tel, "helper_orphan"):
+                    pass
+        """,
+    }
+    findings = _lint(
+        tmp_path, files, rules=["metrics-catalog"], targets=("dmosopt_tpu",)
+    )
+    live = _live(findings, "metrics-catalog")
+    missing = {
+        name
+        for f in live
+        for name in ("mystery_span", "orphan_span", "helper_orphan")
+        if name in f.message
+    }
+    assert missing == {"mystery_span", "orphan_span", "helper_orphan"}
+    assert len(live) == 3, [f.message for f in live]
+    assert all("span" in f.message for f in live)
+
+
 # ------------------------------------------------- suppression hygiene
 
 

@@ -182,6 +182,105 @@ def test_service_failure_isolation():
     svc.close()
 
 
+def test_service_step_phase_timers_and_introspect(tmp_path):
+    """step() decomposes into admit/eval/fit/fold timers surfaced as
+    `service_step_seconds{phase=}`; introspect() reports per-tenant
+    state + attributed cost, queue depths, the last step's phases, and
+    the loadavg-normalized throughput check; the status_path snapshot
+    is published atomically and the `status` CLI renders it."""
+    import json
+
+    from click.testing import CliRunner
+
+    status_path = str(tmp_path / "status.json")
+    svc = OptimizationService(telemetry=True, status_path=status_path)
+    h0 = _submit(svc, dim=4, seed=1)
+    h1 = _submit(svc, dim=4, seed=2)
+    svc.step()
+
+    reg = svc.telemetry.registry
+    for phase in ("admit", "eval", "fit", "fold", "step"):
+        summ = reg.histogram_summary("service_step_seconds", phase=phase)
+        assert summ is not None and summ["count"] == 1, phase
+    step_s = reg.histogram_summary("service_step_seconds", phase="step")
+    parts = sum(
+        reg.histogram_summary("service_step_seconds", phase=p)["sum"]
+        for p in ("admit", "eval", "fit", "fold")
+    )
+    assert parts <= step_s["sum"]
+
+    snap = svc.introspect()
+    assert snap["steps"] == 1 and not snap["closed"]
+    assert snap["tenant_counts"] == {"active": 2}
+    by_id = {t["opt_id"]: t for t in snap["tenants"]}
+    for h in (h0, h1):
+        t = by_id[h.opt_id]
+        assert t["state"] == "active" and t["epoch"] == 1
+        # batched epoch landed attributed cost on the handle
+        assert t["cost_seconds"]["fit"] > 0 and t["cost_seconds"]["ea"] > 0
+        assert t["gens_per_sec"] > 0
+        assert h.cost_seconds["fit"] > 0
+    assert snap["queue_depths"]["pending_submissions"] == 0
+    assert snap["last_step"]["n_advanced"] == 2
+    assert set(snap["last_step"]["phases"]) == {"admit", "eval", "fit", "fold"}
+    # first step: its own wall IS the baseline
+    assert snap["throughput"]["status"] == "ok"
+    assert snap["throughput"]["cpu_count"] >= 1
+
+    # status file published atomically, CLI renders it
+    with open(status_path) as fh:
+        published = json.load(fh)
+    assert published["steps"] == 1
+    from dmosopt_tpu.cli import status as status_cmd
+
+    result = CliRunner().invoke(status_cmd, ["-p", status_path])
+    assert result.exit_code == 0, result.output
+    assert "active=2" in result.output
+    assert "throughput: ok" in result.output
+    for opt_id in (h0.opt_id, h1.opt_id):
+        assert opt_id in result.output
+    as_json = CliRunner().invoke(
+        status_cmd, ["-p", status_path, "--as-json"]
+    )
+    assert as_json.exit_code == 0
+    assert json.loads(as_json.output)["steps"] == 1
+
+    svc.run()
+    done = svc.introspect()
+    assert done["tenant_counts"] == {"completed": 2}
+    # cumulative handle cost grew across both epochs and stays
+    # consistent with the retired snapshots
+    by_id = {t["opt_id"]: t for t in done["tenants"]}
+    for h in (h0, h1):
+        # snapshots round to 6 decimals
+        assert by_id[h.opt_id]["cost_seconds"]["fit"] == pytest.approx(
+            h.cost_seconds["fit"], abs=1e-6
+        )
+    svc.close()
+    final = json.load(open(status_path))
+    assert final["closed"] is True
+
+
+def test_service_throughput_check_normalizes_by_loadavg(monkeypatch):
+    """The BENCH_r04/r05 trap at runtime: a >2x per-tenant step
+    regression reads `host_contended` on a loaded host and
+    `regression_suspect` on an idle one."""
+    svc = OptimizationService(telemetry=False)
+    svc._best_step_s_per_tenant = 1.0
+    svc._last_step = {"wall_s_per_tenant": 5.0}
+    ncpu = os.cpu_count() or 1
+
+    monkeypatch.setattr(os, "getloadavg", lambda: (ncpu * 2.0, 0.0, 0.0))
+    assert svc._throughput_check()["status"] == "host_contended"
+    monkeypatch.setattr(os, "getloadavg", lambda: (0.1, 0.0, 0.0))
+    assert svc._throughput_check()["status"] == "regression_suspect"
+    svc._last_step = {"wall_s_per_tenant": 1.5}
+    assert svc._throughput_check()["status"] == "ok"
+    svc._last_step = {}
+    assert svc._throughput_check()["status"] == "no_data"
+    svc.close()
+
+
 def test_service_close_marks_incomplete_tenants_errored():
     svc = OptimizationService()
     h = _submit(svc, dim=4, seed=9, n_epochs=3)

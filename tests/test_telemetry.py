@@ -186,6 +186,46 @@ def test_jsonl_roundtrip(tmp_path):
     assert all("ts" in d and "kind" in d for d in lines)
 
 
+def test_jsonl_crash_tail_survives_kill(tmp_path):
+    """Satellite: the JSONL sink flushes on every `phase` close, so a
+    run killed WITHOUT `close()` keeps everything up to its last
+    completed phase. Simulated faithfully: a subprocess emits events
+    and dies via os._exit (no interpreter teardown, no atexit, no
+    buffered-file flush)."""
+    import subprocess
+    import sys
+
+    sink = tmp_path / "crash.jsonl"
+    script = f"""
+import os, sys
+sys.path.insert(0, {repr(str(tmp_path.parent))})
+from dmosopt_tpu.telemetry import EventLog
+log = EventLog(jsonl_path={str(sink)!r})
+log.emit("epoch", epoch=0, duration_s=1.0)
+log.emit("phase", epoch=1, phase="train", duration_s=0.5)
+log.emit("phase", epoch=1, phase="optimize", duration_s=0.25)
+os._exit(9)  # killed: no close(), no interpreter shutdown
+"""
+    import os as _os
+
+    env = dict(_os.environ)
+    env["PYTHONPATH"] = _os.pathsep.join(
+        p for p in (env.get("PYTHONPATH"),
+                    str(_os.path.dirname(_os.path.dirname(__file__))))
+        if p
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 9, proc.stderr
+    events = list(read_jsonl(str(sink)))
+    # everything up to the last completed phase survived the kill
+    assert [e.kind for e in events] == ["epoch", "phase", "phase"]
+    assert events[-1].fields["phase"] == "optimize"
+    assert events[-1].epoch == 1
+
+
 def test_epoch_summary_folds_phase_and_eval_events():
     tel = Telemetry()
     tel.set_epoch(0)

@@ -303,6 +303,75 @@ def test_stats_per_problem_validation():
         _driver_with_fake_strategies("stats_bad", 2, stats_per_problem="yes")
 
 
+def test_batched_tenants_carry_cost_attribution():
+    """Each batched tenant's stats carry its attributed share of the
+    bucket's fit/EA/compile walls; shares sum to the measured bucket
+    wall (exact by construction — the 5% acceptance gate is pinned far
+    tighter), and `get_stats` serves them under the usual per-problem
+    prefixes."""
+    dmosopt_tpu.run(
+        _zdt1_params(
+            "tenants_cost", tenant_batching=True, problem_ids=set([0, 1]),
+            telemetry=True,
+        ),
+        verbose=False,
+    )
+    d = dopt_dict["tenants_cost"]
+    for pid in (0, 1):
+        stats = d.optimizer_dict[pid].stats
+        assert stats["cost_fit_seconds"] > 0
+        assert stats["cost_ea_seconds"] > 0
+        assert stats["cost_compile_seconds"] >= 0
+    # the LAST bucket epoch's shares sum to its measured wall
+    last = d.telemetry.log.records(kind="tenant_bucket")[-1].fields
+    total = sum(
+        d.optimizer_dict[pid].stats[k]
+        for pid in (0, 1)
+        for k in (
+            "cost_fit_seconds", "cost_ea_seconds", "cost_compile_seconds",
+        )
+    )
+    assert total == pytest.approx(last["fit_s"] + last["ea_s"], rel=1e-3)
+    # per-problem stats prefixes (the PR 5 collision fix) apply to the
+    # cost keys like any other numeric stat
+    out = d.get_stats()
+    assert out["0_cost_fit_seconds"] > 0 and out["1_cost_fit_seconds"] > 0
+    assert "cost_fit_seconds" not in out
+    # cumulative attribution across BOTH epochs matches the registry
+    attributed = sum(
+        d.telemetry.registry.snapshot()["counters"]
+        .get("tenant_cost_seconds", {})
+        .values()
+    )
+    walls = sum(
+        ev.fields["fit_s"] + ev.fields["ea_s"]
+        for ev in d.telemetry.log.records(kind="tenant_bucket")
+    )
+    assert attributed == pytest.approx(walls, rel=1e-3)
+
+
+def test_get_stats_cost_keys_aggregate_beyond_limit():
+    """Satellite: beyond the 16-problem guard the per-tenant cost keys
+    aggregate to `_mean`s (never colliding into one unprefixed key —
+    the PR 5 class)."""
+    n = DistOptimizer._STATS_PER_PROBLEM_LIMIT + 4
+    d = _driver_with_fake_strategies("stats_cost_agg", n)
+    for pid in d.problem_ids:
+        d.optimizer_dict[pid].stats.update(
+            cost_fit_seconds=0.1 * (pid + 1),
+            cost_ea_seconds=0.01,
+            cost_compile_seconds=0.0,
+        )
+    out = d.get_stats()
+    assert "cost_fit_seconds" not in out  # no unprefixed collision
+    assert not any(k.startswith(f"{n - 1}_cost") for k in out)
+    assert out["cost_fit_seconds_mean"] == pytest.approx(
+        np.mean([0.1 * (pid + 1) for pid in range(n)])
+    )
+    assert out["cost_ea_seconds_mean"] == pytest.approx(0.01)
+    assert out["stats_n_problems"] == n
+
+
 def test_batched_tenants_carry_fit_stats():
     """The batched path records the same stats["objective"] fit summary
     the sequential epoch gets from mdl.get_stats()."""

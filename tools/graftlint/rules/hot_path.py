@@ -35,14 +35,21 @@ _NUMPY_COERCIONS = {
     "numpy.copy", "numpy.save", "numpy.savetxt", "numpy.asfortranarray",
 }
 
-#: telemetry emission methods (facade + registry), string-literal-named
+#: telemetry emission methods (facade + registry + tracer),
+#: string-literal-named — span opens inside a trace would time tracing
+#: instead of execution, exactly like the metric emissions
 _EMIT_METHODS = {
     "inc", "gauge", "observe", "event",
     "counter_inc", "gauge_set", "histogram_observe",
+    "span", "record_span",
 }
 
 #: module-level telemetry helpers that are likewise eager-only
-_TELEMETRY_HELPERS = ("telemetry.phase_scope", "telemetry.record_device_memory")
+_TELEMETRY_HELPERS = (
+    "telemetry.phase_scope",
+    "telemetry.span_scope",
+    "telemetry.record_device_memory",
+)
 
 #: logging methods on objects plausibly being loggers
 _LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical"}

@@ -1,4 +1,4 @@
-"""metrics-catalog: every emitted metric name is documented.
+"""metrics-catalog: every emitted metric AND span name is documented.
 
 The AST-based absorption of ``tools/lint_metrics.py`` (which now
 delegates here, keeping ``make lint-metrics`` and the fast-suite hook
@@ -6,7 +6,12 @@ working unchanged): every telemetry emission in the package — the
 facade's ``.inc(`` / ``.gauge(`` / ``.observe(`` and the registry's
 ``.counter_inc(`` / ``.gauge_set(`` / ``.histogram_observe(`` — whose
 first argument is a string literal must be backticked somewhere in
-``docs/observability.md``.
+``docs/observability.md``. Tracing spans are held to the same
+contract: span names opened via ``.span(`` / ``.record_span(`` (the
+`Tracer` / `Telemetry` surface) or through the ``span_scope(tel,
+"name")`` helper must appear in the catalog's span taxonomy, so an
+undocumented span turns ``make lint`` red exactly like an uncataloged
+metric.
 """
 
 from __future__ import annotations
@@ -22,8 +27,26 @@ EMIT_METHODS = (
     "inc", "gauge", "observe",
     "counter_inc", "gauge_set", "histogram_observe",
 )
+#: span-opening attribute calls: name is the FIRST argument
+SPAN_METHODS = ("span", "record_span")
+#: span-opening helper functions: name is the SECOND argument
+#: (the first is the telemetry object)
+SPAN_HELPERS = ("span_scope",)
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 CATALOG_RELPATH = Path("docs") / "observability.md"
+
+
+def _literal_name(node: ast.Call, index: int):
+    if len(node.args) <= index:
+        return None
+    arg = node.args[index]
+    if (
+        isinstance(arg, ast.Constant)
+        and isinstance(arg.value, str)
+        and _NAME_RE.match(arg.value)
+    ):
+        return arg.value
+    return None
 
 
 def emissions_in_tree(tree: ast.AST):
@@ -34,12 +57,33 @@ def emissions_in_tree(tree: ast.AST):
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
             and node.func.attr in EMIT_METHODS
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-            and isinstance(node.args[0].value, str)
-            and _NAME_RE.match(node.args[0].value)
         ):
-            yield node.args[0].value, node
+            name = _literal_name(node, 0)
+            if name is not None:
+                yield name, node
+
+
+def spans_in_tree(tree: ast.AST):
+    """Yield ``(name, node)`` for every span opened in a parsed module:
+    ``.span('name', ...)`` / ``.record_span('name', ...)`` attribute
+    calls and ``span_scope(tel, 'name', ...)`` helper calls."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in SPAN_METHODS:
+                name = _literal_name(node, 0)
+                if name is not None:
+                    yield name, node
+            elif func.attr in SPAN_HELPERS:
+                name = _literal_name(node, 1)
+                if name is not None:
+                    yield name, node
+        elif isinstance(func, ast.Name) and func.id in SPAN_HELPERS:
+            name = _literal_name(node, 1)
+            if name is not None:
+                yield name, node
 
 
 def catalog_names(doc_path: Path) -> set:
@@ -79,12 +123,13 @@ def check(package_root: Path, doc_path: Path) -> list:
 class MetricsCatalogRule(Rule):
     name = "metrics-catalog"
     description = (
-        "every telemetry metric name emitted in the package is "
-        "backticked in docs/observability.md"
+        "every telemetry metric name emitted and span name opened in "
+        "the package is backticked in docs/observability.md"
     )
     incident = (
         "PR 1 observability contract: an uncataloged metric is invisible "
-        "to the telemetry CLI consumers and rots undocumented"
+        "to the telemetry CLI consumers and rots undocumented; ISSUE 9 "
+        "extended the same contract to tracing span names"
     )
 
     def check(self, ctx: LintContext):
@@ -103,5 +148,14 @@ class MetricsCatalogRule(Rule):
                         f"metric '{name}' is emitted here but not "
                         f"cataloged in {CATALOG_RELPATH} — document it "
                         f"(name, type, labels, when it moves)",
+                    )
+            for name, node in spans_in_tree(mod.tree):
+                if name not in catalog:
+                    ctx.emit(
+                        findings, self.name, mod, node,
+                        f"tracing span '{name}' is opened here but not "
+                        f"cataloged in {CATALOG_RELPATH} — add it to "
+                        f"the span taxonomy (name, labels, what it "
+                        f"covers)",
                     )
         return findings

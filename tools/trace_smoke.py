@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Trace smoke gate (`make trace-smoke`): run a 2-tenant toy service
+with tracing enabled and schema-validate the exported Chrome trace.
+
+Asserts the full ISSUE-9 tracing contract end to end on a real (tiny)
+service run: the export is schema-valid
+(`telemetry.tracing.validate_chrome_trace`), the span taxonomy's core
+names are present, tenant cost attribution produced `tenant_cost`
+spans with tenant labels nested under bucket spans, and the per-tenant
+attributed seconds sum to the measured bucket walls within 5%.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import numpy as np  # noqa: F401  (jax import below initializes the backend)
+
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+    from dmosopt_tpu.service import OptimizationService
+    from dmosopt_tpu.telemetry.tracing import validate_chrome_trace
+
+    tmpdir = tempfile.mkdtemp(prefix="dmosopt_trace_smoke_")
+    trace_path = os.path.join(tmpdir, "service.trace.json")
+    status_path = os.path.join(tmpdir, "status.json")
+
+    svc = OptimizationService(
+        min_bucket=2,
+        telemetry={"trace_path": trace_path},
+        status_path=status_path,
+    )
+    smk = {"n_starts": 2, "n_iter": 20, "seed": 0}
+    for seed in (1, 2):
+        svc.submit(
+            zdt1,
+            {f"x{i}": [0.0, 1.0] for i in range(3)},
+            ["f1", "f2"],
+            n_epochs=2, population_size=8, num_generations=4, n_initial=3,
+            surrogate_method_kwargs=dict(smk), random_seed=seed,
+        )
+    svc.run()
+    snap = svc.introspect()
+    registry = svc.telemetry.registry
+    cost_series = registry.snapshot()["counters"].get("tenant_cost_seconds", {})
+    events = svc.telemetry.log.records(kind="tenant_bucket")
+    svc.close()
+
+    problems = []
+    if not os.path.isfile(trace_path):
+        problems.append(f"trace file {trace_path} was not written")
+    else:
+        with open(trace_path) as fh:
+            trace = json.load(fh)
+        problems.extend(validate_chrome_trace(trace))
+        names = {
+            ev["name"] for ev in trace["traceEvents"] if ev.get("ph") == "X"
+        }
+        for required in ("epoch", "gp_fit", "ea_scan", "tenant_cost"):
+            if required not in names:
+                problems.append(f"span {required!r} missing from the trace")
+        tenant_labels = {
+            ev["args"].get("tenant")
+            for ev in trace["traceEvents"]
+            if ev.get("ph") == "X" and ev["name"] == "tenant_cost"
+        }
+        if len(tenant_labels - {None}) < 2:
+            problems.append(
+                f"expected tenant_cost spans for 2 tenants, saw labels "
+                f"{sorted(tenant_labels - {None})}"
+            )
+
+    attributed = sum(cost_series.values())
+    bucket_wall = sum(
+        ev.fields.get("fit_s", 0.0) + ev.fields.get("ea_s", 0.0)
+        for ev in events
+    )
+    if bucket_wall <= 0:
+        problems.append("no tenant_bucket events — batched path never ran")
+    elif abs(attributed - bucket_wall) > 0.05 * bucket_wall:
+        problems.append(
+            f"attributed {attributed:.4f}s vs bucket wall "
+            f"{bucket_wall:.4f}s — off by more than 5%"
+        )
+    if not os.path.isfile(status_path):
+        problems.append("status snapshot was not written")
+    elif snap["tenant_counts"].get("completed") != 2:
+        problems.append(f"introspect tenant_counts: {snap['tenant_counts']}")
+
+    if problems:
+        print("trace-smoke: FAIL")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(
+        f"trace-smoke: OK — trace {trace_path} schema-valid, "
+        f"attributed {attributed:.3f}s == bucket wall {bucket_wall:.3f}s "
+        f"(within 5%), status snapshot rendered"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
