@@ -37,6 +37,16 @@ lint-metrics:
 trace-smoke:
 	$(PY) tools/trace_smoke.py
 
+# chaos gate: a seeded DMOSOPT_FAULT_PLAN over a 2-bucket staggered
+# service (one bucket-mate's objective raising, one hanging past the
+# eval timeout, one returning NaNs) — survivors must stay BITWISE-equal
+# to a fault-free run, failing tenants degrade/retire per policy, and
+# the quarantine/failure counters must account for every injected
+# fault (docs/robustness.md; mirrored in the fast suite by
+# tests/test_service_robustness.py)
+chaos:
+	$(PY) tools/chaos_smoke.py
+
 bench:
 	python bench.py
 

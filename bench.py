@@ -1243,6 +1243,17 @@ def child_main():
         # bench tracing on: driver-backed configs export Chrome traces
         # and carry per-run trace_path keys in their result rows
         result["trace_dir"] = os.environ[_TRACE_DIR_ENV]
+    if os.environ.get("DMOSOPT_FAULT_PLAN"):
+        # fault injection active (dmosopt_tpu.testing.faults): every
+        # service-backed cell ran under the named plan — the walls and
+        # front qualities below are CHAOS numbers, not a baseline, and
+        # must never be compared against fault-free rounds
+        result["fault_plan"] = os.environ["DMOSOPT_FAULT_PLAN"]
+        _warn_loud(
+            "DMOSOPT_FAULT_PLAN is set: this bench round runs under "
+            "fault injection; do not compare its numbers to fault-free "
+            "baselines"
+        )
     _emit_partial(result)
 
     if os.environ.get("DMOSOPT_BENCH_SMOKE"):

@@ -427,6 +427,20 @@ def status(status_file, as_json):
         f"writer_backlog={qd.get('writer_backlog', 0)} "
         f"series_overflow_total={snap.get('series_overflow_total', 0)}"
     )
+    writer = snap.get("writer", {})
+    if writer.get("failed") or writer.get("retries_total"):
+        click.echo(
+            f"writer: failed={writer.get('failed', False)} "
+            f"retries_total={writer.get('retries_total', 0)}"
+        )
+        if writer.get("failed"):
+            click.echo(
+                "  note: persistence writer is DEAD (write failed after "
+                "its retry budget) — fronts/checkpoints are no longer "
+                "written; optimization continues"
+            )
+    if snap.get("checkpoint_path"):
+        click.echo(f"checkpoint: {snap['checkpoint_path']}")
     thr = snap.get("throughput", {})
     line = (
         f"throughput: {thr.get('status', 'no_data')} "
@@ -457,14 +471,34 @@ def status(status_file, as_json):
         click.echo("-" * len(header))
         for t in tenants:
             cost = t.get("cost_seconds", {})
-            click.echo(
-                f"{t.get('opt_id', '?'):>20} {t.get('state', '?'):>10} "
+            # an active-but-degraded tenant (eval failures, sub-quorum
+            # epochs) is flagged in place; retirees already carry the
+            # "degraded" state
+            state = t.get("state", "?")
+            if t.get("degraded") and state == "active":
+                state = "active!"
+            line = (
+                f"{t.get('opt_id', '?'):>20} {state:>10} "
                 f"{str(t.get('epoch', '-')) + '/' + str(t.get('n_epochs', '-')):>8} "
                 + _fmt(cost.get("fit"), 8, 3) + " "
                 + _fmt(cost.get("ea"), 8, 3) + " "
                 + _fmt(cost.get("compile"), 10, 3) + " "
                 + _fmt(t.get("gens_per_sec"), 8)
             )
+            extras = []
+            if t.get("eval_failures_total"):
+                extras.append(f"eval_failures={t['eval_failures_total']}")
+            if t.get("failed_epochs_consecutive"):
+                extras.append(
+                    f"subquorum_epochs={t['failed_epochs_consecutive']}"
+                )
+            if t.get("points_quarantined_total"):
+                extras.append(
+                    f"quarantined={t['points_quarantined_total']}"
+                )
+            if extras:
+                line += "  [" + " ".join(extras) + "]"
+            click.echo(line)
     if snap.get("trace_path"):
         click.echo(f"trace: {snap['trace_path']}")
 

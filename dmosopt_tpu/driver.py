@@ -686,6 +686,23 @@ class DistOptimizer:
         evals = self.old_evals.get(problem_id)
         if not evals:
             return None
+        # non-finite guard on restore: stores written before the
+        # quarantine era (or by other tools) may carry NaN/inf rows —
+        # they must not re-enter GP training data through a restart
+        finite = [
+            bool(np.all(np.isfinite(np.asarray(e.objectives, np.float64))))
+            for e in evals
+        ]
+        if not all(finite):
+            n_bad = len(finite) - sum(finite)
+            self.logger.warning(
+                f"problem {problem_id}: dropped {n_bad} non-finite "
+                f"objective row(s) from the restored archive "
+                f"(quarantine guard)"
+            )
+            evals = [e for e, ok in zip(evals, finite) if ok]
+            if not evals:
+                return None
         epochs = None
         if evals[0].epoch is not None:
             epochs = np.concatenate([e.epoch for e in evals], axis=None)
@@ -1063,7 +1080,8 @@ class DistOptimizer:
                 y, kwargs["c"] = rres[0], rres[1]
             else:
                 y = rres
-            entry = self.optimizer_dict[problem_id].complete_request(
+            strat = self.optimizer_dict[problem_id]
+            entry = strat.complete_request(
                 eval_req.parameters,
                 np.asarray(y),
                 pred=eval_req.prediction,
@@ -1071,6 +1089,12 @@ class DistOptimizer:
                 time=t,
                 **kwargs,
             )
+            if strat.quarantined and strat.quarantined[-1] is entry:
+                # quarantined non-finite row: kept out of the archive
+                # AND the persisted eval log — a restart rebuilds its
+                # archive from storage, so a persisted NaN row would
+                # re-enter GP training data through the back door
+                continue
             self.storage_dict[problem_id].append(entry)
             if self.verbose:
                 prms = list(zip(self.param_names, list(eval_req.parameters.T)))

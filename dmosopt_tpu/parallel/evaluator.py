@@ -40,6 +40,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from dmosopt_tpu.utils import jittered_backoff
+
 
 class EvalFailure:
     """Terminal failure of ONE evaluation request (the batch survives).
@@ -130,11 +132,16 @@ class _HostEvalHandle(AsyncEvalHandle):
     is abandoned: its eventual completion is ignored and a fresh attempt
     is submitted while the worker slot drains."""
 
-    def __init__(self, evaluator, payloads, timeout, retries):
+    def __init__(
+        self, evaluator, payloads, timeout, retries,
+        backoff=0.0, backoff_cap=30.0,
+    ):
         super().__init__(len(payloads))
         self._ev = evaluator
         self._timeout = timeout
         self._retries = int(retries)
+        self._backoff = float(backoff)
+        self._backoff_cap = float(backoff_cap)
         self._lock = threading.Lock()
         self._done_q: "queue.Queue" = queue.Queue()
         self._reqs = [_HostRequest(i, p) for i, p in enumerate(payloads)]
@@ -162,8 +169,20 @@ class _HostEvalHandle(AsyncEvalHandle):
         # (a partially healthy pool keeps making progress AND keeps the
         # n_workers concurrency cap the user asked for)
         dedicated = dedicated or self._ev._pool_exhausted()
+        # capped exponential backoff before a RETRY attempt executes
+        # (first attempts start immediately). Jittered so a batch of
+        # simultaneous failures doesn't retry in lockstep; the sleep
+        # happens on the worker before started_at is set, so the
+        # timeout clock still measures objective execution only.
+        delay = 0.0
+        if req.attempts_used > 0 and self._backoff > 0.0:
+            delay = jittered_backoff(
+                req.attempts_used - 1, self._backoff, self._backoff_cap
+            )
 
-        def run(payload=req.payload, index=index, attempt=attempt):
+        def run(payload=req.payload, index=index, attempt=attempt, delay=delay):
+            if delay > 0.0:
+                time.sleep(delay)
             with self._lock:
                 r = self._reqs[index]
                 if r.attempt == attempt:
@@ -431,17 +450,26 @@ class HostFunEvaluator:
         space_vals_list: Sequence[Dict[Any, np.ndarray]],
         timeout: Optional[float] = None,
         retries: int = 0,
+        backoff: float = 0.0,
+        backoff_cap: float = 30.0,
         **_unused,
     ) -> AsyncEvalHandle:
         """Asynchronous evaluation: one pool future per request, results
         streaming back through the returned handle as they complete.
         ``timeout`` bounds each attempt's execution seconds; a request
         is retried up to ``retries`` times after a timeout or an
-        objective exception, then delivered as an `EvalFailure`."""
+        objective exception, then delivered as an `EvalFailure`. Retry
+        attempt k waits ``min(backoff * 2**(k-1), backoff_cap)``
+        (jittered) before executing — give a transiently failing
+        objective room to recover instead of burning the whole retry
+        budget inside one outage."""
         tel = self.telemetry
         if tel:
             tel.inc("eval_batches_total", backend="host")
-        return _HostEvalHandle(self, list(space_vals_list), timeout, retries)
+        return _HostEvalHandle(
+            self, list(space_vals_list), timeout, retries,
+            backoff=backoff, backoff_cap=backoff_cap,
+        )
 
     def close(self, drain_timeout: float = 30.0):
         if self._pool is None:

@@ -33,8 +33,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional, Union
+
+from dmosopt_tpu.utils import jittered_backoff
 
 #: pipeline modes, in increasing order of overlap
 PIPELINE_MODES = ("serial", "overlap_io", "speculative")
@@ -125,16 +128,36 @@ class BackgroundWriter:
     executed — the driver calls it before any state a restart could
     observe (end of each epoch, run teardown).
 
-    Errors: a closure that raises kills the writer — the exception is
-    re-raised (wrapped) from the next `submit`/`flush`/`close` call on
-    the driver thread, every subsequent closure is skipped, and the
-    writer refuses new submissions from then on, so a failed append can
-    never be followed by later writes (an archive with a silent gap is
-    worse than a dead run).
+    Errors: a *transient* write failure (`OSError` — the class HDF5 and
+    filesystem hiccups surface as) is retried in place up to
+    ``max_retries`` times with capped exponential backoff plus jitter
+    (``min(backoff · 2^k, backoff_cap)``), counted in `retries_total`
+    and ``writer_retries_total``; ordering is preserved because the
+    single worker simply re-runs the same closure before touching the
+    next. A closure that still fails after the budget — or raises any
+    non-OSError — kills the writer: the exception is re-raised
+    (wrapped) from the next `submit`/`flush`/`close` call on the driver
+    thread, every subsequent closure is skipped, and the writer refuses
+    new submissions from then on, so a failed append can never be
+    followed by later writes (an archive with a silent gap is worse
+    than a dead run). `writer_failed` exposes that terminal state
+    without forcing callers to trip over the raise (the service's
+    `introspect()` and the `status` CLI read it).
     """
 
-    def __init__(self, name: str = "dmosopt-writer", telemetry=None):
+    def __init__(
+        self,
+        name: str = "dmosopt-writer",
+        telemetry=None,
+        max_retries: int = 3,
+        backoff: float = 0.05,
+        backoff_cap: float = 2.0,
+    ):
         self.telemetry = telemetry
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.retries_total = 0
         self._q: "queue.Queue" = queue.Queue()
         self._error: Optional[BaseException] = None
         self._failed = False  # error already surfaced; writer is dead
@@ -152,19 +175,39 @@ class BackgroundWriter:
                     return
                 fn, args, kwargs = item
                 if self._error is None and not self._failed:
-                    try:
-                        # each persistence closure becomes one h5_write
-                        # tracing span on the writer's own track
-                        # (duck-typed: external telemetry objects
-                        # without .span are simply not traced)
-                        span = getattr(self.telemetry, "span", None)
-                        if self.telemetry and span is not None:
-                            with span("h5_write"):
+                    attempt = 0
+                    while True:
+                        try:
+                            # each persistence closure becomes one
+                            # h5_write tracing span on the writer's own
+                            # track (duck-typed: external telemetry
+                            # objects without .span are simply not
+                            # traced)
+                            span = getattr(self.telemetry, "span", None)
+                            if self.telemetry and span is not None:
+                                with span("h5_write"):
+                                    fn(*args, **kwargs)
+                            else:
                                 fn(*args, **kwargs)
-                        else:
-                            fn(*args, **kwargs)
-                    except BaseException as e:  # surfaced on driver thread
-                        self._error = e
+                            break
+                        except OSError as e:
+                            # transient IO: retry in place with capped
+                            # exponential backoff + jitter before
+                            # declaring the writer dead
+                            if attempt >= self.max_retries:
+                                self._error = e
+                                break
+                            delay = jittered_backoff(
+                                attempt, self.backoff, self.backoff_cap
+                            )
+                            attempt += 1
+                            self.retries_total += 1
+                            if self.telemetry:
+                                self.telemetry.inc("writer_retries_total")
+                            time.sleep(delay)
+                        except BaseException as e:  # surfaced on driver thread
+                            self._error = e
+                            break
             finally:
                 self._q.task_done()
 
@@ -188,6 +231,13 @@ class BackgroundWriter:
     @property
     def queue_depth(self) -> int:
         return self._q.qsize()
+
+    @property
+    def writer_failed(self) -> bool:
+        """True once a write has terminally failed (retries exhausted or
+        a non-transient error) — whether or not the wrapped exception
+        has been re-raised to a caller yet."""
+        return self._failed or self._error is not None
 
     def submit(self, fn, *args, **kwargs) -> None:
         if self._closed:

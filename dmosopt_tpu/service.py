@@ -33,13 +33,14 @@ import os
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass
+from collections.abc import Iterator
+from dataclasses import asdict, dataclass
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
-from dmosopt_tpu.datatypes import OptProblem, ParameterSpace
+from dmosopt_tpu.datatypes import EvalRequest, OptProblem, ParameterSpace
 from dmosopt_tpu.driver import eval_obj_fun_sp
 from dmosopt_tpu.parallel.evaluator import (
     EvalFailure,
@@ -62,6 +63,82 @@ _COST_KEYS = (
     ("cost_ea_seconds", "ea"),
     ("cost_compile_seconds", "compile"),
 )
+
+#: conservative per-attempt evaluation timeout applied when no
+#: `EvalPolicy` names one — a wedged objective must not hang `step()`
+#: forever even on an unconfigured service (docs/configuration.md)
+DEFAULT_EVAL_TIMEOUT = 600.0
+
+
+@dataclass(frozen=True)
+class EvalPolicy:
+    """Per-tenant evaluation fault policy (docs/robustness.md).
+
+    timeout: per-attempt wall-clock budget in seconds for one objective
+        call; ``None`` uses the service's ``default_eval_timeout``
+        (never "wait forever" — that is how a wedged objective used to
+        hang `step()`).
+    retries: resubmissions allowed per request after a timeout or an
+        objective exception (threaded into the evaluators' existing
+        ``submit_batch(timeout=, retries=)`` machinery).
+    backoff / backoff_cap: capped exponential backoff (jittered) before
+        each retry attempt executes — see
+        `parallel.evaluator.HostFunEvaluator.submit_batch`.
+    on_eval_failure: what a request that exhausts its budget does to
+        its tenant —
+        ``"retire"`` (default): the tenant fails immediately, matching
+        the pre-policy service behavior; bucket-mates are unaffected.
+        ``"skip"``: the failed point is dropped from the fold and the
+        tenant continues (degraded); only an epoch with ZERO successful
+        evaluations counts against ``max_failed_epochs``.
+        ``"quorum"``: like skip, but an epoch whose success fraction
+        falls below ``min_success_fraction`` counts as failed.
+    min_success_fraction: the quorum threshold (``"quorum"`` only).
+    max_failed_epochs: consecutive failed epochs before a degraded
+        tenant is retired (state ``"degraded"``, error on its handle —
+        never an exception out of `step()`).
+    """
+
+    timeout: Optional[float] = None
+    retries: int = 0
+    backoff: float = 0.0
+    backoff_cap: float = 30.0
+    on_eval_failure: str = "retire"
+    min_success_fraction: float = 0.5
+    max_failed_epochs: int = 3
+
+    def __post_init__(self):
+        if self.on_eval_failure not in ("retire", "skip", "quorum"):
+            raise ValueError(
+                f"on_eval_failure must be 'retire', 'skip' or 'quorum'; "
+                f"got {self.on_eval_failure!r}"
+            )
+        if not (0.0 < self.min_success_fraction <= 1.0):
+            raise ValueError(
+                f"min_success_fraction must be in (0, 1]; "
+                f"got {self.min_success_fraction}"
+            )
+        if self.retries < 0 or self.max_failed_epochs < 1:
+            raise ValueError(
+                "retries must be >= 0 and max_failed_epochs >= 1"
+            )
+        if self.timeout is not None and self.timeout <= 0:
+            raise ValueError(f"timeout must be positive; got {self.timeout}")
+
+    @classmethod
+    def from_spec(
+        cls, spec: Union[None, Dict, "EvalPolicy"]
+    ) -> Optional["EvalPolicy"]:
+        """None passes through (caller falls back to the service
+        default); a dict becomes constructor kwargs."""
+        if spec is None or isinstance(spec, cls):
+            return spec
+        if isinstance(spec, dict):
+            return cls(**spec)
+        raise TypeError(
+            f"eval_policy must be None, dict, or EvalPolicy; "
+            f"got {type(spec)!r}"
+        )
 
 
 @dataclass
@@ -139,6 +216,19 @@ class _Tenant:
     param_names: Tuple[str, ...]
     objective_names: Tuple[str, ...]
     epochs_run: int = 0
+    # fault-policy state (docs/robustness.md): the resolved policy,
+    # whether the evaluator honors per-request timeouts (host backends
+    # do; a jitted batch is all-or-nothing), and degradation accounting
+    policy: Optional[EvalPolicy] = None
+    host_like: bool = False
+    eval_failures: int = 0  # cumulative failed evaluation requests
+    failed_epochs: int = 0  # CONSECUTIVE sub-quorum evaluation rounds
+    degraded: bool = False
+    quarantined_seen: int = 0  # strategy n_quarantined already counted
+    last_success_fraction: Optional[float] = None
+    # checkpoint/resume: the JSON-able submit kwargs needed to rebuild
+    # this tenant's strategy in a fresh process
+    submit_spec: Optional[Dict[str, Any]] = None
 
 
 class OptimizationService:
@@ -154,12 +244,33 @@ class OptimizationService:
         telemetry=None,
         logger=logger,
         status_path: Optional[str] = None,
+        eval_policy: Union[None, Dict, EvalPolicy] = None,
+        default_eval_timeout: float = DEFAULT_EVAL_TIMEOUT,
+        checkpoint_path: Optional[str] = None,
     ):
         self.min_bucket = int(min_bucket)
         self.telemetry = create_telemetry(telemetry)
         self._owns_telemetry = not isinstance(telemetry, Telemetry)
         self.logger = logger
         self.status_path = status_path
+        # service-wide fault policy default (per-submit eval_policy
+        # overrides it) and the conservative per-attempt timeout used
+        # when neither names one — a wedged objective cannot hang a
+        # step forever even on an unconfigured service
+        self.eval_policy = EvalPolicy.from_spec(eval_policy)
+        self.default_eval_timeout = float(default_eval_timeout)
+        # crash-safe resume: full per-tenant state snapshot rewritten
+        # atomically (write-temp-rename) at every epoch boundary;
+        # `OptimizationService.resume(checkpoint_path, ...)` rebuilds
+        self.checkpoint_path = checkpoint_path
+        # deterministic fault injection, env-gated: one seeded plan per
+        # service so `after`/`count` windows span the whole run
+        self._fault_plan = None
+        if os.environ.get("DMOSOPT_FAULT_PLAN"):
+            from dmosopt_tpu.testing.faults import FaultPlan
+
+            self._fault_plan = FaultPlan.from_env()
+        self._writer_error_logged = False
         self._pending: List[_Tenant] = []
         self._active: Dict[int, _Tenant] = {}
         self._ids = itertools.count()
@@ -202,12 +313,17 @@ class OptimizationService:
         random_seed: Optional[int] = None,
         file_path: Optional[str] = None,
         evaluator=None,
+        eval_policy: Union[None, Dict, EvalPolicy] = None,
+        surrogate_refit=None,
+        _restore: Optional[Dict[str, Any]] = None,
     ) -> TenantHandle:
         """Submit one optimization problem; it joins a bucket at the
         next epoch boundary (`step()`). ``obj_fun`` is a jax-traceable
         batch objective (``jax_objective=True``, evaluated through the
-        jitted batch evaluator) or a per-point host function. Returns a
-        `TenantHandle` streaming the tenant's fronts."""
+        jitted batch evaluator) or a per-point host function.
+        ``eval_policy`` overrides the service-wide fault policy for
+        this tenant (docs/robustness.md). Returns a `TenantHandle`
+        streaming the tenant's fronts."""
         if self._closed:
             raise RuntimeError("service is closed")
         if surrogate_method_name is None:
@@ -215,6 +331,7 @@ class OptimizationService:
                 "the service runs surrogate-mode epochs; "
                 "surrogate_method_name=None is not supported"
             )
+        policy = EvalPolicy.from_spec(eval_policy) or self.eval_policy
         tenant_id = next(self._ids)
         opt_id = opt_id or f"tenant_{tenant_id}"
         handle = TenantHandle(tenant_id, opt_id)
@@ -234,6 +351,14 @@ class OptimizationService:
                 if jax_objective
                 else HostFunEvaluator(eval_fun)
             )
+            # owned evaluators report into the service's telemetry
+            # (eval_timeouts/retries/failures_total — the degradation
+            # accounting the policy layer is judged by)
+            evaluator.telemetry = self.telemetry
+        if self._fault_plan is not None:
+            from dmosopt_tpu.testing.faults import FaultyEvaluator
+
+            evaluator = FaultyEvaluator(evaluator, self._fault_plan, opt_id)
         strat = DistOptStrategy(
             prob,
             n_initial=n_initial,
@@ -245,21 +370,58 @@ class OptimizationService:
             optimizer_kwargs=optimizer_kwargs,
             surrogate_method_name=surrogate_method_name,
             surrogate_method_kwargs=surrogate_method_kwargs,
+            surrogate_refit=surrogate_refit,
+            surrogate_refit_state=(
+                (_restore or {}).get("state", {}).get("refit")
+            ),
             local_random=np.random.default_rng(random_seed),
             logger=self.logger,
             telemetry=None,  # per-bucket service telemetry only
         )
+        # everything a fresh process needs to rebuild this tenant from a
+        # checkpoint (the objective itself is re-supplied to `resume`)
+        submit_spec = {
+            "space": space,
+            "objective_names": list(objective_names),
+            "jax_objective": bool(jax_objective),
+            "n_epochs": int(n_epochs),
+            "population_size": int(population_size),
+            "num_generations": int(num_generations),
+            "n_initial": int(n_initial),
+            "initial_method": initial_method,
+            "resample_fraction": float(resample_fraction),
+            "optimizer_name": optimizer_name,
+            "optimizer_kwargs": optimizer_kwargs,
+            "surrogate_method_name": surrogate_method_name,
+            "surrogate_method_kwargs": surrogate_method_kwargs,
+            "random_seed": random_seed,
+            "file_path": file_path,
+            "eval_policy": asdict(policy) if policy is not None else None,
+            "surrogate_refit": (
+                surrogate_refit
+                if isinstance(surrogate_refit, (str, dict, type(None)))
+                else None  # controller/config objects are not JSON-able
+            ),
+        }
         tenant = _Tenant(
             handle=handle, strat=strat, evaluator=evaluator,
             owns_evaluator=owns_evaluator, n_epochs=int(n_epochs),
             file_path=file_path,
             param_names=tuple(param_space.parameter_names),
             objective_names=tuple(objective_names),
+            policy=policy,
+            host_like=hasattr(evaluator, "eval_fun"),
+            submit_spec=submit_spec,
         )
+        if _restore is not None:
+            self._apply_restore(tenant, _restore)
         with self._lock:
             self._pending.append(tenant)
         if self.telemetry:
-            self.telemetry.inc("tenants_submitted_total")
+            if _restore is not None:
+                self.telemetry.inc("tenants_resumed_total")
+            else:
+                self.telemetry.inc("tenants_submitted_total")
         return handle
 
     # -------------------------------------------------------------- step
@@ -294,62 +456,255 @@ class OptimizationService:
             task_reqs.append(req)
         return task_args, task_reqs
 
+    def _effective_timeout(self, tenant: _Tenant) -> float:
+        pol = tenant.policy
+        if pol is not None and pol.timeout is not None:
+            return float(pol.timeout)
+        return self.default_eval_timeout
+
+    def _drain_deadline(self, tenant: _Tenant, n_requests: int) -> float:
+        """Whole-batch wall-clock backstop for one tenant's drain. Host
+        backends enforce the per-attempt timeout internally and may run
+        requests sequentially through a narrow pool, so their backstop
+        scales with the batch; a jitted batch is one device program —
+        the per-attempt budget IS the batch budget. Either way the
+        backstop only fires on work the per-request machinery cannot
+        bound (a wedged device program, a broken custom evaluator)."""
+        pol = tenant.policy or EvalPolicy()
+        budget = self._effective_timeout(tenant) * (pol.retries + 1)
+        budget += (pol.backoff_cap if pol.backoff > 0 else 0.0) * pol.retries
+        if tenant.host_like:
+            budget *= max(n_requests, 1)
+        return budget + 30.0
+
+    def _collect_results(self, tenant, handle, task_args):
+        """Drain one tenant's submitted batch into a submission-order
+        result list (entries are result dicts, `EvalFailure`s, or None
+        for requests lost to the deadline backstop). Returns
+        ``(results, fatal_exception)``."""
+        n = len(task_args)
+        if handle is None:
+            # custom evaluator without submit_batch: the synchronous
+            # call runs on a helper thread bounded by the same deadline
+            # backstop — a wedged evaluate_batch cannot hang step()
+            # (the thread itself cannot be killed; it is daemonic and
+            # its tenant is failed)
+            box: Dict[str, Any] = {}
+
+            def call():
+                try:
+                    box["res"] = list(
+                        tenant.evaluator.evaluate_batch(task_args)
+                    )
+                except Exception as e:
+                    box["err"] = e
+
+            th = threading.Thread(
+                target=call, daemon=True, name="dmosopt-eval-batch"
+            )
+            th.start()
+            th.join(self._drain_deadline(tenant, n))
+            if th.is_alive():
+                if self.telemetry:
+                    self.telemetry.inc("eval_deadline_exceeded_total")
+                return None, RuntimeError(
+                    f"tenant {tenant.handle.opt_id!r}: evaluate_batch "
+                    f"exceeded the evaluation deadline backstop"
+                )
+            if "err" in box:
+                return None, box["err"]
+            return box["res"], None
+        buffered: Dict[int, Any] = {}
+        deadline = time.monotonic() + self._drain_deadline(tenant, n)
+        try:
+            while not handle.done:
+                if time.monotonic() >= deadline:
+                    # wedged evaluation the per-request machinery could
+                    # not bound: abandon what is still in flight and
+                    # mark the missing requests timed out — the step
+                    # must not hang even with no policy configured
+                    handle.cancel_pending()
+                    if self.telemetry:
+                        self.telemetry.inc("eval_deadline_exceeded_total")
+                    self.logger.warning(
+                        f"tenant {tenant.handle.opt_id!r}: evaluation "
+                        f"drain exceeded its deadline backstop with "
+                        f"{n - len(buffered)} request(s) undelivered"
+                    )
+                    for i in range(n):
+                        buffered.setdefault(
+                            i, EvalFailure(None, 1, timed_out=True)
+                        )
+                    break
+                item = handle.poll(timeout=1.0)
+                if item is None:
+                    continue
+                buffered[item[0]] = item[1]
+        except Exception as e:
+            return None, e
+        return [buffered.get(i) for i in range(n)], None
+
+    def _fold_tenant_results(self, tenant: _Tenant, results, task_reqs) -> int:
+        """Fold one tenant's results in submission order under its
+        fault policy: failed points are dropped from the fold (or, for
+        the default ``"retire"`` policy, fail the tenant), non-finite
+        rows are quarantined by `DistOptStrategy.complete_request`, and
+        sub-quorum epochs advance the degradation state machine."""
+        pol = tenant.policy or EvalPolicy()
+        n_total = len(task_reqs)
+        n_failed = 0
+        # requests that produced nothing the archive can use — exhausted
+        # failures AND quarantined (non-finite) returns — kept for the
+        # no-archive re-issue below, so a tenant whose whole design was
+        # lost keeps retrying (bounded by max_failed_epochs) instead of
+        # idling forever with an empty queue
+        unusable_reqs: List[EvalRequest] = []
+        n_evals = 0
+        try:
+            for res, req in zip(results, task_reqs):
+                if res is None or isinstance(res, EvalFailure):
+                    n_failed += 1
+                    unusable_reqs.append(req)
+                    if pol.on_eval_failure == "retire":
+                        cause = (
+                            res.error
+                            if isinstance(res, EvalFailure)
+                            else None
+                        )
+                        attempts = (
+                            res.n_attempts
+                            if isinstance(res, EvalFailure)
+                            else 1
+                        )
+                        raise RuntimeError(
+                            f"tenant {tenant.handle.opt_id!r}: evaluation "
+                            f"failed after {attempts} attempt(s)"
+                        ) from cause
+                    continue
+                wall = (
+                    res.pop("time", -1.0) if isinstance(res, dict)
+                    else -1.0
+                )
+                nq_before = tenant.strat.n_quarantined
+                tenant.strat.complete_request(
+                    req.parameters, np.asarray(res[0]),
+                    epoch=req.epoch, pred=req.prediction, time=wall,
+                )
+                if tenant.strat.n_quarantined > nq_before:
+                    unusable_reqs.append(req)
+                n_evals += 1
+        except Exception as e:
+            # per-tenant failure isolation: a broken objective takes
+            # ITS tenant out (handle.error carries the cause), never
+            # the service or its bucket-mates
+            self._fail_tenant(tenant, e)
+            return n_evals
+
+        # quarantine accounting: complete_request diverted non-finite
+        # rows; they count as unsuccessful toward the quorum below
+        n_quarantined = tenant.strat.n_quarantined - tenant.quarantined_seen
+        if n_quarantined > 0:
+            tenant.quarantined_seen = tenant.strat.n_quarantined
+            if self.telemetry:
+                self.telemetry.inc(
+                    "tenant_points_quarantined_total", n_quarantined,
+                    tenant=tenant.handle.opt_id,
+                )
+        if n_failed > 0:
+            tenant.eval_failures += n_failed
+            tenant.degraded = True
+            if self.telemetry:
+                self.telemetry.inc(
+                    "tenant_eval_failures_total", n_failed,
+                    tenant=tenant.handle.opt_id,
+                )
+            self.logger.warning(
+                f"tenant {tenant.handle.opt_id!r}: {n_failed}/{n_total} "
+                f"evaluation(s) failed this epoch; continuing degraded "
+                f"({tenant.eval_failures} failures total)"
+            )
+
+        # successes are requests that produced a finite archive row:
+        # quarantined rows completed "successfully" but contributed
+        # nothing the surrogate can train on
+        n_ok = max(n_evals - n_quarantined, 0)
+        frac = (n_ok / n_total) if n_total else 1.0
+        tenant.last_success_fraction = frac
+        sub_quorum = (
+            frac < pol.min_success_fraction
+            if pol.on_eval_failure == "quorum"
+            else n_ok == 0
+        ) if n_total else False
+        if sub_quorum:
+            tenant.failed_epochs += 1
+            if tenant.failed_epochs >= pol.max_failed_epochs:
+                self._fail_tenant(
+                    tenant,
+                    RuntimeError(
+                        f"tenant {tenant.handle.opt_id!r}: retired after "
+                        f"{tenant.failed_epochs} consecutive sub-quorum "
+                        f"evaluation round(s) "
+                        f"(last success fraction {frac:.2f}, policy "
+                        f"{pol.on_eval_failure!r})"
+                    ),
+                    state="degraded",
+                )
+            elif (
+                tenant.strat.x is None
+                and not tenant.strat.has_completed()
+                and not tenant.strat.has_requests()
+            ):
+                # nothing evaluable ever landed (the whole initial
+                # design failed or was quarantined): without an archive
+                # the tenant cannot fit or resample, so re-issue the
+                # unusable requests — transient faults get another
+                # epoch, bounded by max_failed_epochs
+                for req in unusable_reqs:
+                    tenant.strat.append_request(req)
+        else:
+            tenant.failed_epochs = 0
+        return n_evals
+
     def _drain_evaluations(self):
         """Evaluate every tenant's pending requests: submit ALL batches
         asynchronously first (device batches and host pools overlap
-        across tenants), then fold each tenant's results in submission
-        order."""
+        across tenants) with each tenant's policy timeout/retry budget
+        threaded into `submit_batch`, then fold each tenant's results
+        in submission order under its fault policy."""
         inflight = []
         with span_scope(self.telemetry, "eval_dispatch"):
             for t in self._active.values():
                 task_args, task_reqs = self._gather_tenant_rounds(t)
                 if not task_args:
                     continue
+                pol = t.policy or EvalPolicy()
                 if hasattr(t.evaluator, "submit_batch"):
-                    handle = t.evaluator.submit_batch(task_args)
+                    handle = t.evaluator.submit_batch(
+                        task_args,
+                        timeout=self._effective_timeout(t),
+                        retries=pol.retries,
+                        backoff=pol.backoff,
+                        backoff_cap=pol.backoff_cap,
+                    )
                 else:
                     handle = None
                 inflight.append((t, handle, task_args, task_reqs))
 
         n_evals = 0
         for t, handle, task_args, task_reqs in inflight:
-            try:
-                if handle is None:
-                    results = list(t.evaluator.evaluate_batch(task_args))
-                else:
-                    buffered = {}
-                    while not handle.done:
-                        item = handle.poll(timeout=1.0)
-                        if item is None:
-                            continue
-                        buffered[item[0]] = item[1]
-                    results = [buffered[i] for i in sorted(buffered)]
-                for res, req in zip(results, task_reqs):
-                    if isinstance(res, EvalFailure):
-                        raise RuntimeError(
-                            f"tenant {t.handle.opt_id!r}: evaluation "
-                            f"failed after {res.n_attempts} attempt(s)"
-                        ) from res.error
-                    wall = (
-                        res.pop("time", -1.0) if isinstance(res, dict)
-                        else -1.0
-                    )
-                    t.strat.complete_request(
-                        req.parameters, np.asarray(res[0]),
-                        epoch=req.epoch, pred=req.prediction, time=wall,
-                    )
-                    n_evals += 1
-            except Exception as e:
-                # per-tenant failure isolation: a broken objective takes
-                # ITS tenant out (handle.error carries the cause), never
-                # the service or its bucket-mates
-                self._fail_tenant(t, e)
+            results, fatal = self._collect_results(t, handle, task_args)
+            if fatal is not None:
+                self._fail_tenant(t, fatal)
+                continue
+            n_evals += self._fold_tenant_results(t, results, task_reqs)
         return n_evals
 
-    def _fail_tenant(self, tenant: _Tenant, error: BaseException):
+    def _fail_tenant(
+        self, tenant: _Tenant, error: BaseException, state: str = "failed"
+    ):
         tenant.handle.error = error
         tenant.handle.done = True
-        self._retire(tenant, "failed")
+        self._retire(tenant, state)
         if tenant.owns_evaluator and hasattr(tenant.evaluator, "close"):
             try:
                 tenant.evaluator.close()
@@ -367,9 +722,35 @@ class OptimizationService:
             self.telemetry.inc("tenants_failed_total")
 
     def _submit_write(self, fn, *args, **kwargs):
+        """Queue one persistence closure. A dead writer (terminal write
+        failure after its retry budget) degrades persistence instead of
+        crashing the service: the submission is dropped, the failure is
+        logged ONCE with its cause, and `introspect()`/the `status` CLI
+        surface ``writer_failed`` — optimization itself continues."""
         if self._writer is None:
             self._writer = BackgroundWriter(telemetry=self.telemetry)
-        self._writer.submit(fn, *args, **kwargs)
+        try:
+            self._writer.submit(fn, *args, **kwargs)
+        except RuntimeError:
+            self._note_writer_dead()
+
+    def _note_writer_dead(self):
+        if not self._writer_error_logged:
+            self._writer_error_logged = True
+            self.logger.exception(
+                "background persistence writer is dead (write failed "
+                "after its retry budget); the service continues WITHOUT "
+                "persistence — fronts and checkpoints are no longer "
+                "written (see introspect()['writer'])"
+            )
+
+    def _flush_writer(self):
+        if self._writer is None:
+            return
+        try:
+            self._writer.flush()
+        except RuntimeError:
+            self._note_writer_dead()
 
     def _stream_front(self, tenant: _Tenant, epoch: int):
         bx, by, _, _ = tenant.strat.get_best_evals()
@@ -447,16 +828,29 @@ class OptimizationService:
             ):
                 self._drain_evaluations()
 
-            strategies = {
-                tid: t.strat for tid, t in self._active.items()
-            }
-            epochs = {tid: t.epochs_run for tid, t in self._active.items()}
+            strategies, epochs = {}, {}
+            for tid, t in self._active.items():
+                if t.strat.x is None and not t.strat.has_completed():
+                    # nothing evaluable has ever landed (a degraded
+                    # tenant whose whole initial design failed): there
+                    # is no archive to fit a surrogate on, so the
+                    # tenant idles this step — its re-issued requests
+                    # (or its retirement) are handled by the eval fold
+                    continue
+                strategies[tid] = t.strat
+                epochs[tid] = t.epochs_run
             # no own span: the bucket runs open their gp_fit / ea_scan
             # spans (with tenant_cost children) directly under `epoch`
             with self._step_phase(phases, "fit"):
                 initialize_epochs_batched(
                     strategies, epochs, min_bucket=self.min_bucket,
                     telemetry=self.telemetry, logger=self.logger,
+                    # per-tenant epoch-init failures retire THAT tenant
+                    # (handle.error carries the cause) instead of
+                    # raising out of step() past its bucket-mates
+                    on_error=lambda tid, e: self._fail_tenant(
+                        self._active[tid], e
+                    ),
                 )
 
             with self._step_phase(phases, "fold"), span_scope(
@@ -464,6 +858,8 @@ class OptimizationService:
             ):
                 finished = []
                 for tid, t in list(self._active.items()):
+                    if tid not in strategies:
+                        continue  # idled (no archive) or failed at init
                     try:
                         resample = (t.epochs_run + 1) < t.n_epochs
                         state, _res, _evals = t.strat.update_epoch(
@@ -493,8 +889,11 @@ class OptimizationService:
                         t.evaluator.close()
                     if self.telemetry:
                         self.telemetry.inc("tenants_completed_total")
-            if self._writer is not None:
-                self._writer.flush()
+            # epoch-boundary checkpoint BEFORE the flush: when step()
+            # returns, the snapshot for this boundary is durable — a
+            # kill -9 during the next epoch resumes from here
+            self._checkpoint()
+            self._flush_writer()
             n_advanced = len(strategies)
         if self.telemetry:
             self.telemetry.inc("service_epochs_total")
@@ -530,6 +929,218 @@ class OptimizationService:
                 self._best_step_s_per_tenant = per_tenant
         self._write_status()
 
+    # ------------------------------------------------- checkpoint / resume
+
+    def _tenant_checkpoint(self, t: _Tenant) -> Dict[str, Any]:
+        """One tenant's full resumable state: archive columns, pending
+        request queue (the in-flight work a crash would lose — resume
+        re-issues it), RNG state, epoch counters, degradation
+        accounting, and warm-refit state."""
+        s = t.strat
+        if isinstance(s.reqs, Iterator):
+            s.reqs = deque(s.reqs)
+        reqs = list(s.reqs)
+        arrays: Dict[str, Any] = {
+            "x": s.x, "y": s.y, "f": s.f, "c": s.c, "t": s.t,
+        }
+        pred_width = 0
+        if reqs:
+            arrays["pending_x"] = np.stack(
+                [np.asarray(r.parameters) for r in reqs]
+            )
+            arrays["pending_epoch"] = np.asarray(
+                [int(r.epoch) for r in reqs], dtype=np.int64
+            )
+            has_pred = np.asarray(
+                [r.prediction is not None for r in reqs], dtype=bool
+            )
+            arrays["pending_has_pred"] = has_pred
+            real = [r.prediction for r in reqs if r.prediction is not None]
+            if real:
+                pred_width = int(np.asarray(real[0]).ravel().shape[0])
+                preds = np.full(
+                    (len(reqs), pred_width), np.nan,
+                    dtype=np.asarray(real[0]).dtype,
+                )
+                for i, r in enumerate(reqs):
+                    if r.prediction is not None:
+                        preds[i] = np.asarray(r.prediction).ravel()
+                arrays["pending_pred"] = preds
+        refit_state = (
+            s.refit_controller.export_state()
+            if s.refit_controller is not None
+            else None
+        )
+        state = {
+            "opt_id": t.handle.opt_id,
+            "tenant_id": t.handle.tenant_id,
+            "epochs_run": t.epochs_run,
+            "n_epochs": t.n_epochs,
+            "epoch_index": s.epoch_index,
+            "optimizer_draws": s.optimizer_draws,
+            "rng_state": s.local_random.bit_generator.state,
+            "eval_failures": t.eval_failures,
+            "failed_epochs": t.failed_epochs,
+            "degraded": t.degraded,
+            "quarantined": s.n_quarantined,
+            "quarantined_seen": t.quarantined_seen,
+            "cost_seconds": dict(t.handle.cost_seconds),
+            "pred_width": pred_width,
+            "refit": refit_state,
+        }
+        return {"config": t.submit_spec, "state": state, "arrays": arrays}
+
+    def _checkpoint_payload(self) -> Dict[str, Any]:
+        with self._lock:
+            tenants = list(self._active.values()) + list(self._pending)
+        return {
+            "service": {
+                "ts": time.time(),
+                "steps": self._steps_run,
+                "min_bucket": self.min_bucket,
+            },
+            "tenants": {
+                str(t.handle.tenant_id): self._tenant_checkpoint(t)
+                for t in tenants
+            },
+        }
+
+    def _checkpoint(self):
+        """Queue one epoch-boundary state snapshot (atomic
+        write-temp-rename inside `save_service_checkpoint_to_h5`);
+        `step()` flushes the writer right after, so the boundary is
+        durable by the time the step returns."""
+        if self.checkpoint_path is None:
+            return
+        from dmosopt_tpu.storage import save_service_checkpoint_to_h5
+
+        payload = self._checkpoint_payload()
+        self._submit_write(
+            save_service_checkpoint_to_h5, payload, self.checkpoint_path,
+        )
+        if self.telemetry:
+            self.telemetry.inc("service_checkpoints_total")
+
+    def _apply_restore(self, t: _Tenant, restore: Dict[str, Any]):
+        """Overwrite a freshly constructed tenant with checkpointed
+        state: archive, epoch counters, pending requests, RNG state.
+        The construction-time xinit draw is irrelevant — the RNG state
+        is restored wholesale AFTER it, and the request queue is
+        replaced, so the resumed trajectory continues exactly where the
+        checkpointed one stopped."""
+        st = restore["state"]
+        arrays = restore.get("arrays", {})
+        s = t.strat
+        s.x = arrays.get("x")
+        s.y = arrays.get("y")
+        s.f = arrays.get("f")
+        s.c = arrays.get("c")
+        s.t = arrays.get("t")
+        s.epoch_index = int(st["epoch_index"])
+        # replay the exact number of optimizer-cycle draws the
+        # checkpointed run consumed (tracked, not derived: a
+        # bucket-fallback epoch draws twice), so multi-optimizer
+        # tenants resume on the right cycle position
+        draws = int(st.get("optimizer_draws", s.epoch_index + 1))
+        for _ in range(draws):
+            next(s.optimizer_iter)
+        s.optimizer_draws = draws
+        s.local_random.bit_generator.state = st["rng_state"]
+        s.n_quarantined = int(st.get("quarantined", 0))
+        if s.n_quarantined:
+            s.stats["n_quarantined"] = s.n_quarantined
+        reqs: deque = deque()
+        px = arrays.get("pending_x")
+        if px is not None:
+            eps = arrays.get("pending_epoch")
+            has = arrays.get("pending_has_pred")
+            preds = arrays.get("pending_pred")
+            for i in range(px.shape[0]):
+                pred = (
+                    preds[i]
+                    if preds is not None and has is not None and bool(has[i])
+                    else None
+                )
+                reqs.append(EvalRequest(px[i], pred, int(eps[i])))
+        s.reqs = reqs
+        t.epochs_run = int(st["epochs_run"])
+        t.eval_failures = int(st.get("eval_failures", 0))
+        t.failed_epochs = int(st.get("failed_epochs", 0))
+        t.degraded = bool(st.get("degraded", False))
+        t.quarantined_seen = int(st.get("quarantined_seen", 0))
+        t.handle.tenant_id = int(st["tenant_id"])
+        for k, v in (st.get("cost_seconds") or {}).items():
+            t.handle.cost_seconds[k] = float(v)
+
+    @classmethod
+    def resume(
+        cls,
+        checkpoint_path: str,
+        objectives: Dict[str, Any],
+        *,
+        evaluators: Optional[Dict[str, Any]] = None,
+        min_bucket: Optional[int] = None,
+        telemetry=None,
+        logger=logger,
+        status_path: Optional[str] = None,
+        default_eval_timeout: float = DEFAULT_EVAL_TIMEOUT,
+        checkpoint: bool = True,
+    ) -> Tuple["OptimizationService", Dict[str, TenantHandle]]:
+        """Reconstruct a service from its epoch-boundary checkpoint.
+
+        Rebuilds every stored (incomplete) tenant — archive, epoch
+        counters, degradation state, RNG state — re-issues its pending
+        (in-flight at crash time) evaluation requests, and returns
+        ``(service, {opt_id: handle})``. Objective functions are code,
+        not state: supply them per tenant through ``objectives``
+        (matching each stored ``opt_id``), or a ready evaluator through
+        ``evaluators``. The resumed run is seeded-trajectory-equivalent
+        to the uninterrupted one from the checkpointed boundary on
+        (pinned by tests/test_service_robustness.py); fronts streamed
+        before the crash are in the tenants' own ``file_path`` stores,
+        not replayed. With ``checkpoint=True`` (default) the resumed
+        service keeps checkpointing to the same path."""
+        from dmosopt_tpu.storage import load_service_checkpoint_from_h5
+
+        data = load_service_checkpoint_from_h5(checkpoint_path)
+        svc = cls(
+            min_bucket=(
+                int(min_bucket)
+                if min_bucket is not None
+                else int(data["service"].get("min_bucket", 2))
+            ),
+            telemetry=telemetry,
+            logger=logger,
+            status_path=status_path,
+            default_eval_timeout=default_eval_timeout,
+            checkpoint_path=checkpoint_path if checkpoint else None,
+        )
+        evaluators = evaluators or {}
+        objectives = objectives or {}
+        handles: Dict[str, TenantHandle] = {}
+        max_tid = -1
+        for key in sorted(data["tenants"], key=int):
+            tp = data["tenants"][key]
+            cfg = dict(tp["config"] or {})
+            st = tp["state"]
+            opt_id = st["opt_id"]
+            obj = objectives.get(opt_id)
+            evaluator = evaluators.get(opt_id)
+            if obj is None and evaluator is None:
+                raise ValueError(
+                    f"resume: no objective (or evaluator) supplied for "
+                    f"stored tenant {opt_id!r}"
+                )
+            space = cfg.pop("space")
+            objective_names = cfg.pop("objective_names")
+            handles[opt_id] = svc.submit(
+                obj, space, objective_names,
+                opt_id=opt_id, evaluator=evaluator, _restore=tp, **cfg,
+            )
+            max_tid = max(max_tid, int(st["tenant_id"]))
+        svc._ids = itertools.count(max_tid + 1)
+        return svc, handles
+
     # ------------------------------------------------------ introspection
 
     @staticmethod
@@ -550,6 +1161,19 @@ class OptimizationService:
             snap["gens_per_sec"] = round(
                 t.strat.num_generations * t.epochs_run / cost["ea"], 3
             )
+        # degradation state (docs/robustness.md): only surfaced once a
+        # fault has actually touched the tenant, so healthy snapshots
+        # stay exactly as small as before
+        if t.degraded or t.eval_failures or t.failed_epochs:
+            snap["degraded"] = t.degraded
+            snap["eval_failures_total"] = t.eval_failures
+            snap["failed_epochs_consecutive"] = t.failed_epochs
+            if t.last_success_fraction is not None:
+                snap["last_success_fraction"] = round(
+                    t.last_success_fraction, 3
+                )
+        if t.quarantined_seen:
+            snap["points_quarantined_total"] = t.quarantined_seen
         return snap
 
     def _retire_summary(self, t: _Tenant, state: str) -> Dict[str, Any]:
@@ -642,6 +1266,22 @@ class OptimizationService:
                     self._writer.queue_depth if self._writer is not None else 0
                 ),
             },
+            # persistence health: a dead writer degrades the service
+            # (fronts/checkpoints stop) instead of crashing it — this is
+            # where that state is visible (plus the `status` CLI)
+            "writer": {
+                "failed": (
+                    self._writer.writer_failed
+                    if self._writer is not None
+                    else False
+                ),
+                "retries_total": (
+                    self._writer.retries_total
+                    if self._writer is not None
+                    else 0
+                ),
+            },
+            "checkpoint_path": self.checkpoint_path,
             "series_overflow_total": overflow,
             "last_step": dict(self._last_step),
             "throughput": self._throughput_check(),
@@ -684,6 +1324,11 @@ class OptimizationService:
     def close(self):
         if self._closed:
             return
+        # graceful-shutdown checkpoint: still-running tenants' state
+        # survives a deliberate close, so close() + resume() is a clean
+        # migration (a tenant cancelled below is still incomplete in
+        # the snapshot and resumes where it stopped)
+        self._checkpoint()
         self._closed = True
         with self._lock:
             to_cancel = list(self._active.values()) + list(self._pending)
@@ -709,7 +1354,10 @@ class OptimizationService:
             self._active.clear()
             self._pending = []
         if self._writer is not None:
-            self._writer.close()
+            try:
+                self._writer.close()
+            except RuntimeError:
+                self._note_writer_dead()
             self._writer = None
         self._write_status()
         if self.telemetry is not None and self._owns_telemetry:

@@ -504,6 +504,84 @@ def load_fronts_from_h5(fpath, opt_id):
     return dict(sorted(out.items()))
 
 
+# --------------------------------------------------- service checkpointing
+
+#: bumped when the checkpoint layout changes incompatibly
+SERVICE_CHECKPOINT_VERSION = 1
+
+#: per-tenant array columns a service checkpoint may carry
+_CHECKPOINT_ARRAYS = (
+    "x", "y", "f", "c", "t",
+    "pending_x", "pending_pred", "pending_has_pred", "pending_epoch",
+)
+
+
+def save_service_checkpoint_to_h5(payload: Dict, fpath, logger=None):
+    """Atomically persist one full service-state snapshot.
+
+    ``payload`` is the dict `OptimizationService._checkpoint_payload`
+    builds: ``{"service": json-able dict, "tenants": {key: {"config":
+    json-able, "state": json-able, "arrays": {name: ndarray|None}}}}``.
+
+    Crash safety is write-temp-rename: the whole snapshot is written to
+    ``fpath + ".tmp"`` and `os.replace`d over the previous one, so a
+    reader (or a resume after kill -9) only ever sees a complete
+    checkpoint — the last fully written epoch boundary, never a torn
+    file. The snapshot is rewritten in full each time (state, not an
+    append log), which is what makes the rename atomic swap valid.
+    """
+    import os
+
+    h5py = _require_h5py()
+    tmp = fpath + ".tmp"
+    with h5py.File(tmp, "w") as h5:
+        h5.attrs["format"] = "dmosopt_tpu.service_checkpoint"
+        h5.attrs["version"] = SERVICE_CHECKPOINT_VERSION
+        _json_attr(h5, "service", payload.get("service", {}))
+        tg = h5.create_group("tenants")
+        for key, tp in payload["tenants"].items():
+            g = tg.create_group(str(key))
+            _json_attr(g, "config", tp["config"])
+            _json_attr(g, "state", tp["state"])
+            for name in _CHECKPOINT_ARRAYS:
+                arr = tp.get("arrays", {}).get(name)
+                if arr is not None:
+                    g.create_dataset(name, data=np.asarray(arr))
+    os.replace(tmp, fpath)
+    if logger is not None:
+        logger.info(
+            f"service checkpoint: {len(payload['tenants'])} tenant(s) "
+            f"-> {fpath}"
+        )
+
+
+def load_service_checkpoint_from_h5(fpath) -> Dict:
+    """Read back a `save_service_checkpoint_to_h5` snapshot as
+    ``{"service": dict, "tenants": {key: {"config", "state",
+    "arrays"}}}`` (arrays as numpy, absent columns as None)."""
+    h5py = _require_h5py()
+    out: Dict = {"service": {}, "tenants": {}}
+    with h5py.File(fpath, "r") as h5:
+        fmt = h5.attrs.get("format")
+        if fmt != "dmosopt_tpu.service_checkpoint":
+            raise RuntimeError(
+                f"{fpath!r} is not a service checkpoint (format {fmt!r})"
+            )
+        out["service"] = _load_json_attr(h5, "service", {})
+        out["version"] = int(h5.attrs.get("version", 0))
+        for key in h5["tenants"]:
+            g = h5["tenants"][key]
+            out["tenants"][key] = {
+                "config": _load_json_attr(g, "config"),
+                "state": _load_json_attr(g, "state"),
+                "arrays": {
+                    name: (np.asarray(g[name][()]) if name in g else None)
+                    for name in _CHECKPOINT_ARRAYS
+                },
+            }
+    return out
+
+
 def save_stats_to_h5(opt_id, problem_id, epoch, fpath, logger=None, stats=None):
     """Store runtime stats per epoch (reference: dmosopt/dmosopt.py:2243-2282)."""
     h5py = _require_h5py()

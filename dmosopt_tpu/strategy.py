@@ -131,6 +131,10 @@ class DistOptStrategy:
             else {"crossover_prob": 0.9, "mutation_prob": 0.1}
         )
         self.optimizer_iter = itertools.cycle(range(len(self.optimizer_name)))
+        # draws consumed from optimizer_iter — checkpointed and replayed
+        # verbatim on service resume (the count can exceed one per epoch
+        # on a bucket-fallback path, so it is tracked, never derived)
+        self.optimizer_draws = 0
 
         self.completed = []
         self.t = None
@@ -174,6 +178,12 @@ class DistOptStrategy:
         self.opt_gen = None
         self.epoch_index = -1
         self.stats = {}
+        # non-finite objective quarantine (see complete_request): a
+        # bounded recent window of the quarantined entries plus the
+        # exact cumulative count (the window is diagnostics; the count
+        # is the accounting surface)
+        self.quarantined: deque = deque(maxlen=256)
+        self.n_quarantined = 0
 
     def _build_termination(self, conditions):
         """None/falsy -> no criterion; a callable -> called with the
@@ -250,6 +260,26 @@ class DistOptStrategy:
                 if np.ndim(f) == 1:
                     f = np.reshape(f, (1, -1))
         entry = EvalEntry(epoch, x, y, f, c, pred, time)
+        if not np.all(np.isfinite(y.astype(np.float64, copy=False))):
+            # non-finite objectives returned "successfully" must never
+            # reach the archive: one NaN row poisons the standardized
+            # training targets and with them the whole GP fit (and, in
+            # a batched bucket, silently degrades THAT tenant's
+            # surrogate while its bucket-mates stay clean). Quarantine
+            # the row instead — callers read `n_quarantined` for
+            # degradation accounting.
+            self.quarantined.append(entry)
+            self.n_quarantined += 1
+            self.stats["n_quarantined"] = self.n_quarantined
+            if self.logger is not None:
+                self.logger.warning(
+                    f"quarantined non-finite objective row "
+                    f"(y={np.asarray(y).tolist()}); "
+                    f"{self.n_quarantined} total"
+                )
+            if self.telemetry:
+                self.telemetry.inc("points_quarantined_total")
+            return entry
         self.completed.append(entry)
         return entry
 
@@ -356,6 +386,7 @@ class DistOptStrategy:
                 f"or one per optimizer"
             )
         idx = next(self.optimizer_iter)
+        self.optimizer_draws += 1
         merged = dict(self.optimizer_kwargs[idx % len(self.optimizer_kwargs)] or {})
         if self.distance_metric is not None:
             merged["distance_metric"] = self.distance_metric

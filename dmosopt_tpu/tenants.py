@@ -721,6 +721,7 @@ def initialize_epochs_batched(
     min_bucket: int = 2,
     telemetry=None,
     logger=None,
+    on_error=None,
 ):
     """Drive every strategy's epoch initialization, batching bucket-mates
     through one compiled program and routing everyone else through the
@@ -736,7 +737,16 @@ def initialize_epochs_batched(
     batched tenants consume their shared-RNG draws NOW (so the global
     draw order matches the sequential loop) and defer device work.
     Then each bucket runs and installs its per-tenant results.
-    Returns {pid: "batched" | "sequential"} for tests/diagnostics.
+    Returns {pid: "batched" | "sequential" | "failed"} for
+    tests/diagnostics.
+
+    ``on_error``: optional ``callable(pid, exception)``. When provided,
+    a PER-TENANT failure (a sequential `initialize_epoch` raising, a
+    batched tenant's host-side plan build raising) is contained: the
+    callback is invoked, the tenant's routing becomes ``"failed"``, and
+    every other tenant proceeds — the service's failure-isolation
+    contract. When None (the driver's case) such exceptions propagate,
+    matching the historical fail-fast behavior.
     """
     epochs = (
         epoch if isinstance(epoch, dict)
@@ -774,13 +784,36 @@ def initialize_epochs_batched(
     for pid, strat in strategies.items():
         sig = sigs[pid]
         if sig is None or counts[sig] < min_bucket:
-            strat.initialize_epoch(epochs[pid])
+            try:
+                strat.initialize_epoch(epochs[pid])
+            except Exception as e:
+                if on_error is None:
+                    raise
+                if logger is not None:
+                    logger.exception(
+                        f"tenant {pid}: sequential epoch init failed; "
+                        f"isolating ({type(e).__name__})"
+                    )
+                on_error(pid, e)
+                routing[pid] = "failed"
+                continue
             routing[pid] = "sequential"
             continue
-        name, okw = strat._cycled_optimizer()
-        buckets.setdefault(sig, []).append(
-            _build_plan(pid, strat, name, okw)
-        )
+        try:
+            name, okw = strat._cycled_optimizer()
+            plan = _build_plan(pid, strat, name, okw)
+        except Exception as e:
+            if on_error is None:
+                raise
+            if logger is not None:
+                logger.exception(
+                    f"tenant {pid}: batched epoch plan failed; "
+                    f"isolating ({type(e).__name__})"
+                )
+            on_error(pid, e)
+            routing[pid] = "failed"
+            continue
+        buckets.setdefault(sig, []).append(plan)
         routing[pid] = "batched"
 
     for sig, plans in buckets.items():
@@ -799,7 +832,14 @@ def initialize_epochs_batched(
                     f"{len(plans)} tenant(s)"
                 )
             for p in plans:
-                p.strat.initialize_epoch(epochs[p.pid])
+                try:
+                    p.strat.initialize_epoch(epochs[p.pid])
+                except Exception as e:
+                    if on_error is None:
+                        raise
+                    on_error(p.pid, e)
+                    routing[p.pid] = "failed"
+                    continue
                 routing[p.pid] = "sequential"
             continue
         for p in plans:

@@ -1,0 +1,15 @@
+"""Deterministic test doubles for the service stack.
+
+`dmosopt_tpu.testing.faults` is the fault-injection harness (seeded
+`FaultPlan` + `FaultyEvaluator` / `FaultyStore` wrappers) the chaos
+suite and `make chaos` drive the ask/tell service with — see
+docs/robustness.md.
+"""
+
+from dmosopt_tpu.testing.faults import (  # noqa: F401
+    FAULT_PLAN_ENV,
+    FaultPlan,
+    FaultRule,
+    FaultyEvaluator,
+    FaultyStore,
+)

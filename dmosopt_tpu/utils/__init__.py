@@ -21,3 +21,15 @@ def json_default(o):
     raise TypeError(
         f"Object of type {type(o).__name__} is not JSON serializable"
     )
+
+
+def jittered_backoff(attempt: int, base: float, cap: float) -> float:
+    """Capped exponential backoff with jitter: ``min(base·2^attempt,
+    cap)`` scaled uniformly into ``[0.5x, 1.0x)`` so simultaneous
+    failures don't retry in lockstep. ``attempt`` is the zero-based
+    retry index. One definition for every retry loop (background
+    writer, host-evaluator resubmission) so the timing policy cannot
+    drift between them."""
+    import random
+
+    return min(base * 2.0 ** attempt, cap) * (0.5 + 0.5 * random.random())

@@ -1,0 +1,79 @@
+"""Subprocess worker for the kill-9 crash-resume test.
+
+Runs a 3-tenant checkpointing service, completes two epoch boundaries
+(each durable on disk when its `step()` returns), then arms a
+`FaultRule(kind="kill")` on tenant ``t0`` through the service's
+env-gated fault plan — the NEXT objective call SIGKILLs the process
+mid-epoch-3 evaluation. No interpreter teardown, no atexit, no flush:
+whatever `resume()` finds is exactly what the atomic write-temp-rename
+checkpoint protocol guaranteed.
+
+The service/tenant parameters live HERE so the parent test builds its
+uninterrupted reference run (and the resumed continuation) from the
+identical configuration.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+N_TENANTS = 3
+DIM = 4
+N_EPOCHS = 4
+SEEDS = (21, 22, 23)
+SUBMIT_KW = dict(
+    population_size=16,
+    num_generations=4,
+    n_initial=3,
+    surrogate_method_kwargs={"n_starts": 2, "n_iter": 20, "seed": 0},
+)
+SPACE = {f"x{i}": [0.0, 1.0] for i in range(DIM)}
+
+
+def host_zdt1(pp):
+    """Pure-numpy zdt1 per-point objective — bitwise-identical across
+    the worker, the reference run, and the resumed run."""
+    x = np.asarray(
+        [pp[f"x{i}"] for i in range(DIM)], dtype=np.float32
+    ).astype(np.float64)
+    f1 = x[0]
+    g = 1.0 + 9.0 * np.mean(x[1:])
+    f2 = g * (1.0 - np.sqrt(f1 / g))
+    return np.asarray([f1, f2], dtype=np.float64)
+
+
+def submit_all(svc):
+    from dmosopt_tpu.service import OptimizationService  # noqa: F401
+
+    return {
+        f"t{i}": svc.submit(
+            host_zdt1, SPACE, ["f1", "f2"],
+            opt_id=f"t{i}", jax_objective=False,
+            n_epochs=N_EPOCHS, random_seed=SEEDS[i], **SUBMIT_KW,
+        )
+        for i in range(N_TENANTS)
+    }
+
+
+def main(checkpoint_path: str) -> None:
+    # empty plan: the env gate activates injection plumbing; the kill
+    # rule is armed only once two boundaries are durable
+    os.environ["DMOSOPT_FAULT_PLAN"] = '{"seed": 0, "rules": []}'
+    from dmosopt_tpu.service import OptimizationService
+    from dmosopt_tpu.testing.faults import FaultRule
+
+    svc = OptimizationService(
+        telemetry=False, checkpoint_path=checkpoint_path
+    )
+    submit_all(svc)
+    svc.step()
+    svc.step()
+    print("BOUNDARY2", flush=True)
+    svc._fault_plan.rules.append(FaultRule(kind="kill", target="t0"))
+    svc.step()  # SIGKILLed mid-epoch-3 evaluation
+    print("UNREACHABLE", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

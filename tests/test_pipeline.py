@@ -112,6 +112,25 @@ def test_background_writer_surfaces_errors_and_skips_rest():
     assert seen == [1]
 
 
+def test_background_writer_retries_only_transient_errors():
+    """OSError (the HDF5/filesystem hiccup class) is retried with
+    backoff up to the budget; any other exception kills the writer
+    immediately — a logic bug must not be retried into the archive.
+    (The retry-then-success and retry-exhaustion paths are driven
+    deterministically by FaultyStore in tests/test_faults.py.)"""
+    w = BackgroundWriter(max_retries=3, backoff=0.01, backoff_cap=0.05)
+
+    def logic_bug():
+        raise ValueError("not transient")
+
+    w.submit(logic_bug)
+    with pytest.raises(RuntimeError, match="background persistence"):
+        w.flush()
+    assert w.retries_total == 0  # no retry for a non-OSError
+    assert w.writer_failed
+    w.close()
+
+
 def test_background_writer_close_is_idempotent_and_final():
     w = BackgroundWriter()
     w.submit(lambda: None)

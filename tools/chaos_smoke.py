@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Chaos smoke gate (`make chaos`): drive a 2-bucket staggered service
+through a seeded fault plan and assert the fault-tolerance contract.
+
+The scenario is the ISSUE-10 acceptance shape, driven entirely through
+the ``DMOSOPT_FAULT_PLAN`` env gate (no test-only code paths inside the
+service):
+
+- bucket A (d4, 3 bucket-mates): ``t0`` healthy, ``t1``'s objective
+  RAISES on every call, ``t2``'s objective HANGS past the eval timeout;
+- bucket B (d5, 2 tenants): healthy, submitted one step late
+  (staggered phases);
+- ``t_nan`` (d3, own bucket): returns non-finite objectives on a
+  seeded ~half of its calls — the quarantine path.
+
+Asserted invariants:
+
+1. no exception escapes ``step()`` — the failing tenants are degraded
+   and then retired per policy (state ``degraded``, cause on their
+   handles);
+2. every SURVIVING tenant's streamed fronts are **bitwise-equal** to a
+   fault-free run with the same seeds;
+3. quarantine/retire accounting: ``tenant_eval_failures_total`` and
+   ``tenant_points_quarantined_total`` counters, degraded flags in
+   ``introspect()``, and a finite archive for the NaN tenant.
+
+See docs/robustness.md for the failure model this enforces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SMK = {"n_starts": 2, "n_iter": 20, "seed": 0}
+POLICY = {
+    "timeout": 0.15,
+    "retries": 0,
+    "on_eval_failure": "quorum",
+    "min_success_fraction": 0.5,
+    "max_failed_epochs": 2,
+}
+
+FAULT_PLAN = {
+    "seed": 7,
+    "rules": [
+        {"kind": "raise", "target": "t1", "message": "chaos: t1 explodes"},
+        {"kind": "hang", "target": "t2", "delay_s": 0.6},
+        {"kind": "nan", "target": "t_nan", "p": 0.5},
+    ],
+}
+
+
+def _host_zdt1(dim):
+    """Pure-numpy zdt1 per-point host objective: microsecond calls, so
+    the chaos policy's tight eval timeout only ever fires on INJECTED
+    hangs, never on a first-call jit compile."""
+    import numpy as np
+
+    def f(pp):
+        x = np.asarray(
+            [pp[f"x{i}"] for i in range(dim)], dtype=np.float32
+        ).astype(np.float64)
+        f1 = x[0]
+        g = 1.0 + 9.0 * np.mean(x[1:])
+        f2 = g * (1.0 - np.sqrt(f1 / g))
+        return np.asarray([f1, f2], dtype=np.float64)
+
+    return f
+
+
+def _run_service(label):
+    import numpy as np
+
+    from dmosopt_tpu.benchmarks.zdt import zdt1
+    from dmosopt_tpu.service import OptimizationService
+
+    svc = OptimizationService(
+        min_bucket=2, telemetry=True, eval_policy=dict(POLICY)
+    )
+    handles = {}
+
+    def submit(name, dim, seed, *, host, policy=None, **kw):
+        obj = _host_zdt1(dim) if host else zdt1
+        handles[name] = svc.submit(
+            obj,
+            {f"x{i}": [0.0, 1.0] for i in range(dim)},
+            ["f1", "f2"],
+            opt_id=name, jax_objective=not host,
+            population_size=16, num_generations=4, n_initial=3,
+            surrogate_method_kwargs=dict(SMK), random_seed=seed,
+            eval_policy=policy, **kw,
+        )
+
+    # bucket A: three d4 bucket-mates, two of them faulty under the plan
+    submit("t0", 4, 11, host=True, n_epochs=3)
+    submit("t1", 4, 12, host=True, n_epochs=3)
+    submit("t2", 4, 13, host=True, n_epochs=3)
+    # quarantine tenant in its own bucket (skip policy: NaNs degrade,
+    # never retire, as long as some rows survive)
+    submit(
+        "t_nan", 3, 14, host=True, n_epochs=3,
+        policy=dict(POLICY, on_eval_failure="skip"),
+    )
+    svc.step()
+    # bucket B: staggered late joiners (their epoch 0 is the service's
+    # step 2), healthy jitted-batch objectives
+    submit("s0", 5, 15, host=False, n_epochs=2)
+    submit("s1", 5, 16, host=False, n_epochs=2)
+    svc.run()
+
+    fronts = {
+        k: [(u.epoch, u.x, u.y) for u in h.updates()]
+        for k, h in handles.items()
+    }
+    snap = svc.introspect()
+    reg = svc.telemetry.registry
+    counters = {
+        "t1_failures": reg.counter_value(
+            "tenant_eval_failures_total", tenant="t1"
+        ),
+        "t2_failures": reg.counter_value(
+            "tenant_eval_failures_total", tenant="t2"
+        ),
+        "nan_quarantined": reg.counter_value(
+            "tenant_points_quarantined_total", tenant="t_nan"
+        ),
+        "timeouts": reg.counter_value("eval_timeouts_total"),
+    }
+    nan_front = handles["t_nan"].best()
+    nan_archive_finite = (
+        handles["t_nan"].error is None
+        and nan_front is not None
+        and bool(np.all(np.isfinite(nan_front.y)))
+    )
+    svc.close()
+    print(f"[{label}] tenant_counts={snap['tenant_counts']}")
+    return fronts, handles, snap, counters, nan_archive_finite
+
+
+def main() -> int:
+    import numpy as np
+
+    problems = []
+
+    os.environ.pop("DMOSOPT_FAULT_PLAN", None)
+    ref_fronts, ref_handles, _, _, _ = _run_service("fault-free")
+
+    os.environ["DMOSOPT_FAULT_PLAN"] = json.dumps(FAULT_PLAN)
+    try:
+        fronts, handles, snap, counters, nan_finite = _run_service("chaos")
+    finally:
+        os.environ.pop("DMOSOPT_FAULT_PLAN", None)
+
+    # 1. failing tenants degraded/retired per policy, causes on handles
+    for bad in ("t1", "t2"):
+        h = handles[bad]
+        if h.error is None or not h.done:
+            problems.append(f"{bad} should have been retired with a cause")
+    counts = snap["tenant_counts"]
+    if counts.get("degraded", 0) != 2:
+        problems.append(
+            f"expected 2 tenants retired as degraded, got {counts}"
+        )
+    if counts.get("completed", 0) != 4:
+        problems.append(f"expected 4 completed tenants, got {counts}")
+
+    # 2. survivors bitwise-equal to the fault-free run
+    for k in ("t0", "s0", "s1", "t_nan"):
+        survivor = fronts[k]
+        reference = ref_fronts[k]
+        if k == "t_nan":
+            # its own trajectory legitimately differs (quarantined
+            # rows); only full epochs-completed survival is asserted
+            if len(survivor) != len(reference):
+                problems.append(
+                    f"t_nan completed {len(survivor)} epochs vs "
+                    f"{len(reference)} fault-free"
+                )
+            continue
+        if [e for e, _, _ in survivor] != [e for e, _, _ in reference]:
+            problems.append(f"{k}: epoch sequence diverged under faults")
+            continue
+        for (e, xb, yb), (_, xs, ys) in zip(survivor, reference):
+            if not (np.array_equal(xb, xs) and np.array_equal(yb, ys)):
+                problems.append(
+                    f"{k} epoch {e}: front NOT bitwise-equal to the "
+                    f"fault-free run"
+                )
+                break
+
+    # 3. accounting
+    if counters["t1_failures"] <= 0:
+        problems.append("tenant_eval_failures_total{t1} did not count")
+    if counters["t2_failures"] <= 0:
+        problems.append("tenant_eval_failures_total{t2} did not count")
+    if counters["timeouts"] <= 0:
+        problems.append("eval_timeouts_total did not count t2's hangs")
+    if counters["nan_quarantined"] <= 0:
+        problems.append("tenant_points_quarantined_total{t_nan} is zero")
+    if not nan_finite:
+        problems.append("t_nan archive/front contains non-finite rows")
+
+    if problems:
+        print("CHAOS SMOKE FAILED:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(
+        f"chaos smoke OK: survivors bitwise-invariant, "
+        f"t1/t2 degraded+retired "
+        f"({counters['t1_failures']:.0f}/{counters['t2_failures']:.0f} "
+        f"failures), {counters['nan_quarantined']:.0f} rows quarantined"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
