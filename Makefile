@@ -15,12 +15,23 @@ test-all:
 	$(PY) -m pytest tests/ -q -m ""
 
 # graftlint: the JAX-aware static-analysis suite (hot-path purity,
-# frozen-path guard, dtype discipline, retrace hazards, metric catalog)
-# over the package + the jax-free entry points. Pure-ast — runs even
-# when the TPU tunnel is down; also enforced inside the fast suite
-# (tests/test_graftlint.py). Rule catalog: docs/static-analysis.md.
+# frozen-path guard, dtype discipline, retrace hazards, metric catalog,
+# and the concurrency/state-integrity families: shared-state-guard,
+# lock-discipline, checkpoint-schema, resource-lifecycle) over the
+# package + the jax-free entry points. Pure-ast — runs even when the
+# TPU tunnel is down; also enforced inside the fast suite
+# (tests/test_graftlint.py, tests/test_graftlint_concurrency.py).
+# Incremental: unchanged inputs replay from .graftlint_cache.json
+# (--no-cache bypasses). Rule catalog: docs/static-analysis.md;
+# threading model: docs/concurrency.md.
 lint:
 	$(PY) -m tools.graftlint
+
+# the concurrency suite alone, plus the thread-root resolver's verdict
+# (every Thread/executor root and its reachable set with provenance)
+lint-threads:
+	$(PY) -m tools.graftlint --select shared-state-guard,lock-discipline,checkpoint-schema,resource-lifecycle
+	$(PY) -m tools.graftlint --threads
 
 # every metric name emitted in the package must be cataloged in
 # docs/observability.md (also enforced inside the fast suite); now an

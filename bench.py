@@ -23,6 +23,7 @@ import json
 import os
 import sys
 import subprocess
+import threading
 import time
 
 from _procutil import axon_free_pythonpath, communicate_bounded, run_probe
@@ -1072,6 +1073,7 @@ def bench_multi_tenant(tenant_counts=None):
         "problem": f"zdt1 d={dim} pop={pop} gens={ngen} epochs={n_epochs}",
         "backend": jax.default_backend(),
         "loadavg": [round(v, 2) for v in os.getloadavg()],
+        "active_thread_count_start": threading.active_count(),
         "timing": "best-of-2",
     }
     walls = {}
@@ -1097,6 +1099,9 @@ def bench_multi_tenant(tenant_counts=None):
     if trace_paths:
         out["trace_paths"] = trace_paths
     out["loadavg_end"] = [round(v, 2) for v in os.getloadavg()]
+    # service/evaluator thread leaks across the tenant sweep surface
+    # here as end > start (the resource-lifecycle lint's runtime twin)
+    out["active_thread_count_end"] = threading.active_count()
     return {"multi_tenant": out}
 
 
@@ -1237,6 +1242,10 @@ def child_main():
         # read as real regressions
         "backend": jax.default_backend(),
         "loadavg_start": [round(v, 2) for v in os.getloadavg()],
+        # thread-leak canary (paired with active_thread_count_end): a
+        # lifecycle bug that strands evaluator/writer threads shows up
+        # as end > start in the BENCH_* artifact
+        "active_thread_count_start": threading.active_count(),
         "cpu_count": os.cpu_count(),
     }
     if os.environ.get(_TRACE_DIR_ENV):
@@ -1275,6 +1284,7 @@ def child_main():
         st = run_ea_loop(opt, opt.state, jax.random.PRNGKey(2), ngen, zdt1)
         jax.block_until_ready(st.population_obj)
         result.update(value=round(ngen / (time.time() - t0), 2), smoke=True)
+        result["active_thread_count_end"] = threading.active_count()
         print(_dumps(result))
         return
 
@@ -1302,6 +1312,7 @@ def child_main():
             except Exception as e:
                 result["configs"][name] = {"error": f"{type(e).__name__}: {e}"}
             _emit_partial(result)
+        result["active_thread_count_end"] = threading.active_count()
         print(_dumps(result))
         return
 
@@ -1328,6 +1339,7 @@ def child_main():
             }
         _emit_partial(result)
 
+    result["active_thread_count_end"] = threading.active_count()
     print(_dumps(result))
 
 

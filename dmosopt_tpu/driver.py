@@ -860,6 +860,14 @@ class DistOptimizer:
             self._writer.close()
             self._writer = None
 
+    def _close_evaluator(self):
+        """Drain the owned evaluation backend (thread pool / device
+        queue); user-supplied evaluators may be shared across runs and
+        are left alone. The teardown entry the resource-lifecycle lint
+        anchors the evaluator's thread pool to."""
+        if self._owns_evaluator and hasattr(self.evaluator, "close"):
+            self.evaluator.close()
+
     def save_evals(self):
         """Store results of finished evals to file
         (reference dmosopt.py:962-1015)."""
@@ -1617,8 +1625,7 @@ def run(
         except Exception:
             dopt.logger.exception("discarding in-flight results failed")
         try:
-            if dopt._owns_evaluator and hasattr(dopt.evaluator, "close"):
-                dopt.evaluator.close()
+            dopt._close_evaluator()
         except Exception:
             dopt.logger.exception("evaluator close failed")
         try:

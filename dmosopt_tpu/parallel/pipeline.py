@@ -159,6 +159,12 @@ class BackgroundWriter:
         self.backoff_cap = float(backoff_cap)
         self.retries_total = 0
         self._q: "queue.Queue" = queue.Queue()
+        # guards the _error/_failed hand-off between the worker thread
+        # (which records a terminal failure) and the driver thread
+        # (which surfaces it); the write closures themselves run
+        # OUTSIDE the lock — holding it across an h5 append would stall
+        # every submit
+        self._state_lock = threading.Lock()
         self._error: Optional[BaseException] = None
         self._failed = False  # error already surfaced; writer is dead
         self._closed = False
@@ -167,6 +173,10 @@ class BackgroundWriter:
 
     # ------------------------------------------------------------ worker
 
+    def _record_error(self, e: BaseException):
+        with self._state_lock:
+            self._error = e
+
     def _run(self):
         while True:
             item = self._q.get()
@@ -174,7 +184,9 @@ class BackgroundWriter:
                 if item is None:
                     return
                 fn, args, kwargs = item
-                if self._error is None and not self._failed:
+                with self._state_lock:
+                    dead = self._error is not None or self._failed
+                if not dead:
                     attempt = 0
                     while True:
                         try:
@@ -195,7 +207,7 @@ class BackgroundWriter:
                             # exponential backoff + jitter before
                             # declaring the writer dead
                             if attempt >= self.max_retries:
-                                self._error = e
+                                self._record_error(e)
                                 break
                             delay = jittered_backoff(
                                 attempt, self.backoff, self.backoff_cap
@@ -206,7 +218,7 @@ class BackgroundWriter:
                                 self.telemetry.inc("writer_retries_total")
                             time.sleep(delay)
                         except BaseException as e:  # surfaced on driver thread
-                            self._error = e
+                            self._record_error(e)
                             break
             finally:
                 self._q.task_done()
@@ -214,15 +226,17 @@ class BackgroundWriter:
     # ------------------------------------------------------------ driver
 
     def _raise_pending(self):
-        if self._error is not None:
-            # _failed goes up BEFORE _error comes down: the worker
-            # checks `_error is None and not _failed`, and a window
-            # with both clear would let a queued write slip through
-            # after the failure
-            self._failed = True
+        with self._state_lock:
             err, self._error = self._error, None
+            if err is not None:
+                # _failed is set in the same critical section the error
+                # comes down in: the worker's dead-check can never see
+                # both clear after a failure
+                self._failed = True
+            failed = self._failed
+        if err is not None:
             raise RuntimeError("background persistence write failed") from err
-        if self._failed:
+        if failed:
             raise RuntimeError(
                 "background persistence writer is dead after an earlier "
                 "write failure"
@@ -237,7 +251,8 @@ class BackgroundWriter:
         """True once a write has terminally failed (retries exhausted or
         a non-transient error) — whether or not the wrapped exception
         has been re-raised to a caller yet."""
-        return self._failed or self._error is not None
+        with self._state_lock:
+            return self._failed or self._error is not None
 
     def submit(self, fn, *args, **kwargs) -> None:
         if self._closed:
@@ -265,5 +280,7 @@ class BackgroundWriter:
         # only raise an error nobody has seen yet: run() closes the
         # writer inside its finally block, and re-raising an already
         # surfaced failure there would mask the original exception
-        if self._error is not None:
+        with self._state_lock:
+            unseen = self._error is not None
+        if unseen:
             self._raise_pending()

@@ -762,6 +762,9 @@ def test_rule_catalog_complete():
     assert set(rules) == {
         "hot-path-purity", "frozen-path-guard", "dtype-discipline",
         "retrace-hazard", "metrics-catalog",
+        # the concurrency & state-integrity suite (ISSUE 11)
+        "shared-state-guard", "lock-discipline", "checkpoint-schema",
+        "resource-lifecycle",
     }
     for r in rules.values():
         assert r.description and r.incident

@@ -1,0 +1,899 @@
+"""graftlint concurrency & state-integrity suite: thread-root resolver
+units (lambda targets, partial submits, daemon threads, executors in
+context managers, self-dispatched methods, dispatcher chains), the
+true-positive / suppressed / clean fixture triple per new rule family
+(shared-state-guard, lock-discipline, checkpoint-schema,
+resource-lifecycle), the real-package mutation gates of the acceptance
+criteria, the --bump-schema helper, and the incremental result cache.
+Pure ast, like the rest of tests/test_graftlint.py."""
+
+import os
+import shutil
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.graftlint import load_context, run_lint  # noqa: E402
+from tools.graftlint.engine import DEFAULT_TARGETS  # noqa: E402
+
+
+def _mkpkg(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def _lint(tmp_path, files, rules=None, targets=("pkg",), options=None):
+    root = _mkpkg(tmp_path, files)
+    return run_lint(root, targets, rules=rules, options=options)
+
+
+def _live(findings, rule=None):
+    return [
+        f for f in findings
+        if not f.suppressed and (rule is None or f.rule == rule)
+    ]
+
+
+# ------------------------------------------------ thread-root resolver
+
+
+def test_resolver_thread_targets_and_reachability(tmp_path):
+    root = _mkpkg(tmp_path, {"pkg/a.py": """
+        import threading
+
+        def work(x):
+            return x
+
+        def helper():
+            return work(1)
+
+        def spawn():
+            t = threading.Thread(target=helper)
+            t.start()
+            t.join()
+
+        def eager():
+            return helper()
+    """})
+    ctx = load_context(root, ("pkg",))
+    assert ctx.functions["pkg.a.helper"].thread_target
+    root_name = "pkg.a.helper"
+    assert ctx.functions["pkg.a.helper"].thread_roots == {root_name}
+    # reachable from the root, with provenance
+    assert root_name in ctx.functions["pkg.a.work"].thread_roots
+    # the spawner itself does not run on the thread
+    assert not ctx.functions["pkg.a.spawn"].threaded
+    assert not ctx.functions["pkg.a.eager"].threaded
+
+
+def test_resolver_lambda_targets_and_partial_submits(tmp_path):
+    root = _mkpkg(tmp_path, {"pkg/a.py": """
+        import threading
+        from functools import partial
+        from concurrent.futures import ThreadPoolExecutor
+
+        def lam_work():
+            return 1
+
+        def sub_work(cfg):
+            return cfg
+
+        def map_work(x):
+            return x
+
+        def spawn():
+            threading.Thread(target=lambda: lam_work(), daemon=True).start()
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                pool.submit(partial(sub_work, 1))
+                list(pool.map(map_work, [1, 2]))
+    """})
+    ctx = load_context(root, ("pkg",))
+    # the inline lambda is its own root; its callee is thread-reachable
+    assert ctx.functions["pkg.a.lam_work"].threaded
+    assert ctx.functions["pkg.a.sub_work"].thread_target  # partial unwrap
+    assert ctx.functions["pkg.a.map_work"].thread_target  # pool.map
+
+
+def test_resolver_self_method_target_and_dispatcher_chain(tmp_path):
+    """`Thread(target=self._run)` resolves through self-dispatch, and a
+    function forwarding a parameter to `.submit` (the service's
+    `_submit_write`) makes its call-site arguments thread targets."""
+    root = _mkpkg(tmp_path, {"pkg/a.py": """
+        import threading
+
+        def persisted(x):
+            return x
+
+        class Svc:
+            def __init__(self, writer):
+                self._writer = writer
+                self._thread = threading.Thread(target=self._run, daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+            def _submit_write(self, fn, *args):
+                self._writer.submit(fn, *args)
+
+            def stream(self):
+                self._submit_write(persisted, 1)
+
+            def close(self):
+                self._thread.join()
+    """})
+    ctx = load_context(root, ("pkg",))
+    assert ctx.functions["pkg.a.Svc._run"].thread_target
+    assert "fn" in ctx.functions["pkg.a.Svc._submit_write"].dispatch_params
+    assert ctx.functions["pkg.a.persisted"].thread_target
+    assert "dispatched through" in ctx.functions["pkg.a.persisted"].thread_via
+
+
+def test_resolver_dispatcher_of_dispatcher_chain(tmp_path):
+    """A forwarder that hands its own parameter to ANOTHER dispatcher
+    (two levels above the raw `.submit`) still marks call-site
+    arguments as thread roots — the indirection a service refactor
+    naturally introduces over `_submit_write`."""
+    root = _mkpkg(tmp_path, {"pkg/a.py": """
+        def work_two():
+            return 2
+
+        class Svc:
+            def __init__(self, pool):
+                self._pool = pool
+
+            def inner(self, fn):
+                self._pool.submit(fn)
+
+            def outer(self, fn):
+                self.inner(fn)
+
+            def stream(self):
+                self.outer(work_two)
+    """})
+    ctx = load_context(root, ("pkg",))
+    assert "fn" in ctx.functions["pkg.a.Svc.inner"].dispatch_params
+    assert "fn" in ctx.functions["pkg.a.Svc.outer"].dispatch_params
+    assert ctx.functions["pkg.a.work_two"].thread_target
+
+
+def test_resolver_jax_combinators_are_not_thread_dispatch(tmp_path):
+    root = _mkpkg(tmp_path, {"pkg/a.py": """
+        from jax import lax
+
+        def body(x):
+            return x
+
+        def eager(X):
+            return lax.map(body, X)
+    """})
+    ctx = load_context(root, ("pkg",))
+    assert not ctx.functions["pkg.a.body"].thread_target
+    assert ctx.functions["pkg.a.body"].traced_body  # still a jit region
+
+
+# ------------------------------------------------ rule: shared-state-guard
+
+_SHARED_STATE_SRC = {"pkg/a.py": """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.bad = 0
+            self.guarded = 0
+            self.atomic = 0
+            self.local_only = 0
+
+        def _worker(self):
+            self.bad += 1
+            with self._lock:
+                self.guarded += 1
+            self.atomic += 1  # graftlint: disable=shared-state-guard -- fixture: GIL-atomic monotonic counter, single writer
+
+        def start(self):
+            threading.Thread(target=self._worker, daemon=True).start()
+
+        def snapshot(self):
+            with self._lock:
+                return self.bad + self.guarded + self.atomic
+
+        def main_only(self):
+            self.local_only += 1
+            return self.local_only
+"""}
+
+
+def test_shared_state_guard_fixture(tmp_path):
+    findings = _lint(
+        tmp_path, _SHARED_STATE_SRC, rules=["shared-state-guard"]
+    )
+    live = _live(findings, "shared-state-guard")
+    assert len(live) == 1, [f.format() for f in live]
+    assert "'bad'" in live[0].message
+    assert live[0].qualname == "pkg.a.Counter._worker"
+    assert [f for f in findings if f.suppressed], "suppressed variant fires"
+    # guarded / single-context attrs stay silent
+    assert not any("'guarded'" in f.message for f in live)
+    assert not any("'local_only'" in f.message for f in live)
+
+
+def test_shared_state_guard_module_global_and_queue_exemption(tmp_path):
+    findings = _lint(tmp_path, {"pkg/a.py": """
+        import queue
+        import threading
+
+        CACHE = {}
+
+        class Pump:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def _worker(self):
+                CACHE["k"] = 1
+                self._q.put(1)
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def read(self):
+                return CACHE.get("k"), self._q.get_nowait()
+    """}, rules=["shared-state-guard"])
+    live = _live(findings, "shared-state-guard")
+    # the module-global write races the main read; the Queue is exempt
+    assert any("CACHE" in f.message for f in live), [f.format() for f in live]
+    assert not any("_q" in f.message for f in live)
+
+
+def test_shared_state_guard_caller_holds_lock_idiom(tmp_path):
+    """A helper whose EVERY call site runs under the lock is lock-held
+    (the repo's documented 'caller holds self._lock' discipline) — no
+    finding; remove one guarded call site and the helper turns red."""
+    files = {"pkg/a.py": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def _append(self, x):
+                self.items.append(x)
+
+            def _worker(self):
+                with self._lock:
+                    self._append(1)
+
+            def start(self):
+                threading.Thread(target=self._worker, daemon=True).start()
+
+            def push(self, x):
+                with self._lock:
+                    self._append(x)
+    """}
+    findings = _lint(tmp_path, files, rules=["shared-state-guard"])
+    assert not _live(findings), [f.format() for f in _live(findings)]
+
+    # same class, but one call site drops the lock -> the helper's
+    # entry condition collapses and the access is flagged
+    leaky = files["pkg/a.py"].replace(
+        """            def push(self, x):
+                with self._lock:
+                    self._append(x)""",
+        """            def push(self, x):
+                self._append(x)""",
+    )
+    findings = _lint(tmp_path / "b", {"pkg/a.py": leaky},
+                     rules=["shared-state-guard"])
+    live = _live(findings, "shared-state-guard")
+    assert any("items" in f.message for f in live), [
+        f.format() for f in live
+    ]
+
+
+# -------------------------------------------------- rule: lock-discipline
+
+
+def test_lock_discipline_ordering_cycle(tmp_path):
+    findings = _lint(tmp_path, {"pkg/a.py": """
+        import threading
+
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def ba():
+            with lock_b:
+                with lock_a:
+                    pass
+    """}, rules=["lock-discipline"])
+    live = _live(findings, "lock-discipline")
+    assert len(live) == 1 and "cycle" in live[0].message, [
+        f.format() for f in live
+    ]
+
+
+def test_lock_discipline_interprocedural_cycle_and_clean_order(tmp_path):
+    """The A->B edge through a call (holding A, calling a function that
+    takes B) composes with a lexical B->A elsewhere into a cycle; a
+    consistent one-way order stays green."""
+    findings = _lint(tmp_path, {"pkg/a.py": """
+        import threading
+
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def takes_b():
+            with lock_b:
+                pass
+
+        def under_a():
+            with lock_a:
+                takes_b()
+
+        def reversed_order():
+            with lock_b:
+                with lock_a:
+                    pass
+    """}, rules=["lock-discipline"])
+    assert any("cycle" in f.message for f in _live(findings))
+
+    clean = _lint(tmp_path / "clean", {"pkg/a.py": """
+        import threading
+
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def takes_b():
+            with lock_b:
+                pass
+
+        def under_a():
+            with lock_a:
+                takes_b()
+    """}, rules=["lock-discipline"])
+    assert not _live(clean), [f.format() for f in _live(clean)]
+
+
+def test_lock_discipline_manual_acquire_and_blocking(tmp_path):
+    findings = _lint(tmp_path, {"pkg/a.py": """
+        import subprocess
+        import threading
+        import time
+
+        lock = threading.Lock()
+
+        def manual():
+            lock.acquire()
+            lock.release()
+
+        def protected():
+            lock.acquire()
+            try:
+                pass
+            finally:
+                lock.release()
+
+        def sleepy():
+            with lock:
+                time.sleep(1)
+
+        def shelling():
+            with lock:
+                subprocess.run(["true"])
+
+        def suppressed():
+            with lock:
+                time.sleep(0.1)  # graftlint: disable=lock-discipline -- fixture: deliberate bounded stall
+
+        def clean():
+            with lock:
+                x = 1
+            time.sleep(0)
+            return x
+
+        def str_join_is_fine():
+            with lock:
+                return ", ".join(["a", "b"])
+    """}, rules=["lock-discipline"])
+    live = _live(findings, "lock-discipline")
+    by_qual = {}
+    for f in live:
+        by_qual.setdefault(f.qualname, []).append(f.message)
+    assert "pkg.a.manual" in by_qual
+    assert "acquire" in by_qual["pkg.a.manual"][0]
+    assert "pkg.a.protected" not in by_qual
+    assert "pkg.a.sleepy" in by_qual
+    assert "pkg.a.shelling" in by_qual
+    assert "pkg.a.clean" not in by_qual
+    assert "pkg.a.str_join_is_fine" not in by_qual
+    assert [f for f in findings if f.suppressed]
+
+
+def test_lock_discipline_same_lock_nesting_and_rlock(tmp_path):
+    findings = _lint(tmp_path, {"pkg/a.py": """
+        import threading
+
+        lock = threading.Lock()
+        rlock = threading.RLock()
+
+        def deadlock():
+            with lock:
+                with lock:
+                    pass
+
+        def reentrant_ok():
+            with rlock:
+                with rlock:
+                    pass
+    """}, rules=["lock-discipline"])
+    live = _live(findings, "lock-discipline")
+    assert len(live) == 1, [f.format() for f in live]
+    assert "deadlock" in live[0].message
+    assert live[0].qualname == "pkg.a.deadlock"
+
+
+def test_lock_discipline_repo_is_clean():
+    """The real tree's lock hierarchy (service -> handle -> accounting
+    -> telemetry) is acyclic and free of blocking-under-lock — the
+    invariant ROADMAP item 2's task-graph scheduler must preserve."""
+    findings = run_lint(REPO, DEFAULT_TARGETS, rules=["lock-discipline"])
+    assert not _live(findings), "\n".join(
+        f.format() for f in _live(findings)
+    )
+
+
+# ------------------------------------------------ rule: checkpoint-schema
+
+_CKPT_REGISTRY = {
+    "version": 1,
+    "writers": {"state": ["pkg.svc.save"]},
+    "readers": ["pkg.svc.load"],
+    "fields": {"state": {"a": {}, "b": {}}},
+    "storage_arrays": "pkg.svc._ARRAYS",
+    "storage_version": "pkg.svc._VERSION",
+}
+
+_CKPT_SRC = {"pkg/svc.py": """
+    def save(tenant):
+        state = {"a": tenant.a, "b": tenant.b}
+        return {"state": state}
+
+    def load(payload):
+        st = payload["state"]
+        return st["a"], st.get("b")
+"""}
+
+
+def test_checkpoint_schema_symmetric_is_green(tmp_path):
+    findings = _lint(
+        tmp_path, _CKPT_SRC, rules=["checkpoint-schema"],
+        options={"checkpoint_registry": _CKPT_REGISTRY},
+    )
+    assert not _live(findings), [f.format() for f in _live(findings)]
+
+
+def test_checkpoint_schema_write_without_read_is_red(tmp_path):
+    src = {"pkg/svc.py": _CKPT_SRC["pkg/svc.py"].replace(
+        'return st["a"], st.get("b")', 'return st["a"]'
+    )}
+    findings = _lint(
+        tmp_path, src, rules=["checkpoint-schema"],
+        options={"checkpoint_registry": _CKPT_REGISTRY},
+    )
+    live = _live(findings, "checkpoint-schema")
+    assert len(live) == 1 and "never consumed" in live[0].message
+    assert "'state.b'" in live[0].message
+
+    # ... unless the registry marks it write_only (with its reason)
+    reg = {
+        **_CKPT_REGISTRY,
+        "fields": {"state": {"a": {}, "b": {"write_only": True,
+                                           "reason": "fixture"}}},
+    }
+    findings = _lint(
+        tmp_path / "wo", src, rules=["checkpoint-schema"],
+        options={"checkpoint_registry": reg},
+    )
+    assert not _live(findings)
+
+
+def test_checkpoint_schema_read_without_write_and_drift(tmp_path):
+    # reader consumes a field nobody writes
+    src = {"pkg/svc.py": _CKPT_SRC["pkg/svc.py"].replace(
+        'return st["a"], st.get("b")',
+        'return st["a"], st.get("b"), st["ghost"]',
+    )}
+    findings = _lint(
+        tmp_path, src, rules=["checkpoint-schema"],
+        options={"checkpoint_registry": _CKPT_REGISTRY},
+    )
+    live = _live(findings, "checkpoint-schema")
+    assert any("ghost" in f.message and "no writer" in f.message
+               for f in live), [f.format() for f in live]
+
+    # writer gains a field the registry does not know -> bump-schema hint
+    src = {"pkg/svc.py": _CKPT_SRC["pkg/svc.py"].replace(
+        '"b": tenant.b}', '"b": tenant.b, "c": 1}'
+    )}
+    findings = _lint(
+        tmp_path / "w", src, rules=["checkpoint-schema"],
+        options={"checkpoint_registry": _CKPT_REGISTRY},
+    )
+    live = _live(findings, "checkpoint-schema")
+    assert any("bump-schema" in f.message for f in live)
+
+    # writer drops a registered field -> red the other way
+    src = {"pkg/svc.py": _CKPT_SRC["pkg/svc.py"].replace(
+        ', "b": tenant.b}', '}'
+    )}
+    findings = _lint(
+        tmp_path / "d", src, rules=["checkpoint-schema"],
+        options={"checkpoint_registry": _CKPT_REGISTRY},
+    )
+    live = _live(findings, "checkpoint-schema")
+    assert any("no longer written" in f.message for f in live)
+
+
+def test_checkpoint_schema_storage_allowlist_and_version(tmp_path):
+    src = {"pkg/svc.py": _CKPT_SRC["pkg/svc.py"] + (
+        '    _ARRAYS = ("x", "y")\n'
+        '    _VERSION = 2\n'
+    )}
+    reg = {
+        **_CKPT_REGISTRY,
+        "fields": {
+            "state": {"a": {}, "b": {}},
+            "arrays": {"x": {}, "y": {}, "z": {}},
+        },
+    }
+    findings = _lint(
+        tmp_path, src, rules=["checkpoint-schema"],
+        options={"checkpoint_registry": reg},
+    )
+    live = _live(findings, "checkpoint-schema")
+    msgs = "\n".join(f.message for f in live)
+    assert "does not match the schema registry's arrays" in msgs
+    assert "SCHEMA_VERSION" in msgs
+
+
+def _copy_service_sandbox(tmp_path, mutate=None):
+    dst = tmp_path / "dmosopt_tpu"
+    dst.mkdir(parents=True)
+    src = (REPO / "dmosopt_tpu" / "service.py").read_text()
+    if mutate:
+        src = mutate(src)
+    (dst / "service.py").write_text(src)
+    shutil.copy(REPO / "dmosopt_tpu" / "storage.py", dst / "storage.py")
+    return tmp_path
+
+
+def test_checkpoint_schema_real_package_green_and_mutation_red(tmp_path):
+    """The acceptance gate: the shipped save/load paths are symmetric;
+    deleting the `optimizer_draws` read from `_apply_restore` (the PR
+    10 near-miss, verbatim) turns checkpoint-schema red."""
+    root = _copy_service_sandbox(tmp_path / "green")
+    findings = run_lint(root, ("dmosopt_tpu",), rules=["checkpoint-schema"])
+    assert not _live(findings), [f.format() for f in _live(findings)]
+
+    needle = 'draws = int(st.get("optimizer_draws", s.epoch_index + 1))'
+
+    def mutate(src):
+        assert needle in src
+        return src.replace(needle, "draws = int(s.epoch_index + 1)")
+
+    root = _copy_service_sandbox(tmp_path / "red", mutate)
+    findings = run_lint(root, ("dmosopt_tpu",), rules=["checkpoint-schema"])
+    live = _live(findings, "checkpoint-schema")
+    assert len(live) == 1, [f.format() for f in live]
+    assert "optimizer_draws" in live[0].message
+    assert "never consumed" in live[0].message
+
+
+# ----------------------------------------------- rule: resource-lifecycle
+
+
+def test_resource_lifecycle_fixture(tmp_path):
+    findings = _lint(tmp_path, {"pkg/a.py": """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def work():
+            pass
+
+        class Leaky:
+            def __init__(self):
+                self._t = threading.Thread(target=work)
+                self._t.start()
+
+        class Closed:
+            def __init__(self):
+                self._t = threading.Thread(target=work, daemon=True)
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+
+        class Suppressed:
+            def __init__(self):
+                self._t = threading.Thread(target=work)  # graftlint: disable=resource-lifecycle -- fixture: process-lifetime service thread by design
+                self._t.start()
+
+        def local_leak():
+            t = threading.Thread(target=work)
+            t.start()
+
+        def local_joined():
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+
+        def local_daemon():
+            threading.Thread(target=work, daemon=True).start()
+
+        def pool_ctx():
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                pool.submit(work)
+
+        def pool_leak():
+            pool = ThreadPoolExecutor(max_workers=2)
+            pool.submit(work)
+    """}, rules=["resource-lifecycle"])
+    live = _live(findings, "resource-lifecycle")
+    by_qual = {}
+    for f in live:
+        by_qual.setdefault(f.qualname, []).append(f.message)
+    assert "pkg.a.Leaky.__init__" in by_qual  # no teardown path at all
+    assert "no teardown path" in by_qual["pkg.a.Leaky.__init__"][0]
+    assert "pkg.a.Closed.__init__" not in by_qual
+    assert "pkg.a.Suppressed.__init__" not in by_qual
+    assert "pkg.a.local_leak" in by_qual
+    assert "pkg.a.local_joined" not in by_qual
+    assert "pkg.a.local_daemon" not in by_qual
+    assert "pkg.a.pool_ctx" not in by_qual
+    assert "pkg.a.pool_leak" in by_qual
+    assert [f for f in findings if f.suppressed]
+
+
+def test_resource_lifecycle_alias_swap_and_resource_class(tmp_path):
+    """The HostFunEvaluator teardown idiom — `pool, self._pool =
+    self._pool, None` drained inside a nested closure — satisfies the
+    rule, and a class OWNING such a resource class must close it."""
+    findings = _lint(tmp_path, {"pkg/a.py": """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Pooled:
+            def __init__(self):
+                self._pool = ThreadPoolExecutor(max_workers=2)
+
+            def close(self):
+                pool, self._pool = self._pool, None
+                t = threading.Thread(
+                    target=lambda: pool.shutdown(wait=True), daemon=True
+                )
+                t.start()
+                t.join(5.0)
+
+        class Owner:
+            def __init__(self):
+                self._writer = Pooled()
+
+            def close(self):
+                self._writer.close()
+
+        class LeakyOwner:
+            def __init__(self):
+                self._writer = Pooled()
+
+            def close(self):
+                pass
+    """}, rules=["resource-lifecycle"])
+    live = _live(findings, "resource-lifecycle")
+    quals = {f.qualname for f in live}
+    assert quals == {"pkg.a.LeakyOwner.__init__"}, [
+        f.format() for f in live
+    ]
+
+
+def test_resource_lifecycle_real_package_mutations(tmp_path):
+    """Acceptance mutations on real modules: leaking the writer thread
+    past `close()` and unguarding shared writer state both turn their
+    rules red; the shipped source is green."""
+    src = (REPO / "dmosopt_tpu" / "parallel" / "pipeline.py").read_text()
+    dst = tmp_path / "leak" / "dmosopt_tpu" / "parallel"
+    dst.mkdir(parents=True)
+    assert "        self._thread.join()\n" in src
+    (dst / "pipeline.py").write_text(
+        src.replace("        self._thread.join()\n", "")
+    )
+    findings = run_lint(
+        tmp_path / "leak", ("dmosopt_tpu",), rules=["resource-lifecycle"]
+    )
+    live = _live(findings, "resource-lifecycle")
+    assert any("_thread" in f.message for f in live), [
+        f.format() for f in live
+    ]
+
+    # shared-state: drop the state lock around the worker's error write
+    dst = tmp_path / "race" / "dmosopt_tpu" / "parallel"
+    dst.mkdir(parents=True)
+    needle = (
+        "    def _record_error(self, e: BaseException):\n"
+        "        with self._state_lock:\n"
+        "            self._error = e\n"
+    )
+    assert needle in src
+    (dst / "pipeline.py").write_text(src.replace(
+        needle,
+        "    def _record_error(self, e: BaseException):\n"
+        "        self._error = e\n",
+    ))
+    findings = run_lint(
+        tmp_path / "race", ("dmosopt_tpu",), rules=["shared-state-guard"]
+    )
+    live = _live(findings, "shared-state-guard")
+    assert any("_error" in f.message for f in live), [
+        f.format() for f in live
+    ]
+
+
+# --------------------------------------------------- --bump-schema helper
+
+_SCHEMA_REGISTRY_SRC = '''
+SCHEMA_VERSION = 1
+WRITERS = {"state": ["pkg.svc.save"]}
+READERS = ["pkg.svc.load"]
+FIELDS = {
+    "state": {
+        "a": {},
+        "b": {"write_only": True, "reason": "kept for humans"},
+    },
+}
+STORAGE_ARRAYS = "pkg.svc._ARRAYS"
+STORAGE_VERSION = "pkg.svc._VERSION"
+'''
+
+
+def test_bump_schema_rewrites_fields_preserving_meta(tmp_path):
+    from tools.graftlint.bump import bump_schema
+
+    root = _mkpkg(tmp_path, _CKPT_SRC)
+    reg_path = root / "checkpoint_registry.py"
+    reg_path.write_text(_SCHEMA_REGISTRY_SRC)
+
+    # in sync -> no-op, file untouched
+    before = reg_path.read_text()
+    assert bump_schema(root, ("pkg",), registry_path=reg_path) == {}
+    assert reg_path.read_text() == before
+
+    # writer gains "c" and drops "a" -> bump updates FIELDS, keeps b's
+    # write_only meta verbatim
+    (root / "pkg/svc.py").write_text(textwrap.dedent("""
+        def save(tenant):
+            state = {"b": tenant.b, "c": 1}
+            return {"state": state}
+
+        def load(payload):
+            st = payload["state"]
+            return st.get("c")
+    """))
+    changed = bump_schema(root, ("pkg",), registry_path=reg_path)
+    assert changed == {"state": ({"c"}, {"a"})}
+    ns = {}
+    exec(reg_path.read_text(), ns)
+    assert set(ns["FIELDS"]["state"]) == {"b", "c"}
+    assert ns["FIELDS"]["state"]["b"] == {
+        "write_only": True, "reason": "kept for humans"
+    }
+    assert ns["FIELDS"]["state"]["c"] == {}
+
+
+def test_bump_schema_real_registry_is_in_sync():
+    """The shipped checkpoint registry matches the shipped save path —
+    a schema drift cannot land without its bump (mirrors the frozen-
+    hash in-sync gate)."""
+    import shutil as _shutil
+
+    import tempfile
+
+    from tools.graftlint.bump import DEFAULT_SCHEMA_REGISTRY, bump_schema
+
+    with tempfile.TemporaryDirectory() as td:
+        copy = Path(td) / "checkpoint_registry.py"
+        _shutil.copy(DEFAULT_SCHEMA_REGISTRY, copy)
+        changed = bump_schema(REPO, DEFAULT_TARGETS, registry_path=copy)
+        assert changed == {}, f"schema registry out of sync: {changed}"
+
+
+# --------------------------------------------------- incremental cache
+
+
+def _cache_fixture(tmp_path):
+    root = _mkpkg(tmp_path, {"pkg/a.py": """
+        import jax
+
+        @jax.jit
+        def bad(x):
+            print(x)
+            return x
+    """})
+    return root
+
+
+def test_cache_roundtrip_touch_and_invalidation(tmp_path):
+    from tools.graftlint.cache import LintCache
+
+    root = _cache_fixture(tmp_path)
+    cache = LintCache(root)
+    targets, rules = ("pkg",), ["hot-path-purity"]
+    assert cache.load(targets, rules) is None  # cold
+
+    findings = run_lint(root, targets, rules=rules)
+    assert _live(findings)
+    cache.store(targets, rules, findings)
+
+    hit = cache.load(targets, rules)
+    assert hit is not None
+    assert [f.format() for f in hit] == [f.format() for f in findings]
+    assert (root / ".graftlint_cache.json").is_file()
+
+    # touch (mtime moves, content identical) -> still a hit
+    p = root / "pkg" / "a.py"
+    st = p.stat()
+    os.utime(p, ns=(st.st_mtime_ns + 10**9, st.st_mtime_ns + 10**9))
+    assert cache.load(targets, rules) is not None
+
+    # different rule selection -> its own (empty) slot, AND storing it
+    # must not evict the first entry (multi-entry cache)
+    assert cache.load(targets, None) is None
+    cache.store(targets, None, run_lint(root, targets))
+    assert cache.load(targets, None) is not None
+    assert cache.load(targets, rules) is not None
+    # real edit -> miss for every entry
+    p.write_text(p.read_text().replace("print(x)", "pass"))
+    assert cache.load(targets, rules) is None
+    assert cache.load(targets, None) is None
+
+
+def test_cache_invalidates_on_new_target_file(tmp_path):
+    from tools.graftlint.cache import LintCache
+
+    root = _cache_fixture(tmp_path)
+    cache = LintCache(root)
+    findings = run_lint(root, ("pkg",))
+    cache.store(("pkg",), None, findings)
+    assert cache.load(("pkg",), None) is not None
+    (root / "pkg" / "b.py").write_text("x = 1\n")
+    assert cache.load(("pkg",), None) is None
+
+
+def test_cache_cli_roundtrip_matches_uncached(tmp_path):
+    """`python -m tools.graftlint` (the `make lint` surface) returns
+    identical findings and exit status on the cached second run, and
+    --no-cache never writes the cache file."""
+    import subprocess
+
+    env = {**os.environ, "PYTHONPATH": str(REPO)}
+    cmd = [sys.executable, "-m", "tools.graftlint", "--select",
+           "hot-path-purity,shared-state-guard"]
+    first = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=str(REPO), env=env
+    )
+    second = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=str(REPO), env=env
+    )
+    assert first.returncode == second.returncode == 0
+    assert first.stdout == second.stdout
+    assert (REPO / ".graftlint_cache.json").is_file()

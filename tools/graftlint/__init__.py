@@ -5,8 +5,13 @@ even when the TPU tunnel is down). The engine parses the target modules,
 resolves import aliases, builds a call graph, and marks every function
 whose body is traced — reachable from a ``jax.jit`` / ``lax.scan`` /
 ``lax.while_loop`` / ``shard_map`` region — so rules can distinguish the
-device hot path from eager host code. Rule catalog, suppression syntax
-and the frozen-path registry procedure: docs/static-analysis.md.
+device hot path from eager host code. A symmetric thread-root resolver
+marks everything reachable from a ``threading.Thread`` target or an
+executor ``submit``/``map`` dispatch, feeding the concurrency rule
+families (shared-state-guard, lock-discipline, checkpoint-schema,
+resource-lifecycle). Rule catalog, suppression syntax and the
+frozen-path/checkpoint-schema registry procedures:
+docs/static-analysis.md and docs/concurrency.md.
 """
 
 from tools.graftlint.engine import (  # noqa: F401

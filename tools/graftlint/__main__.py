@@ -11,14 +11,22 @@ Options:
     --select R1,R2    run only these rules
     --list-rules      print the rule catalog and exit
     --hot             print every jit-region function with provenance
+    --threads         print every thread root and its reachable set
+                      with provenance (the thread-root resolver)
     --frozen-hashes   print current normalized hashes of all registered
                       frozen functions (copy-paste for registry bumps)
     --bump-frozen N   rewrite tools/graftlint/frozen_registry.py hashes
                       from the CURRENT source for the named qualnames
                       (comma list, or "all"); pair every bump with a
                       re-bake of the run-time pins the entry names
-    --registry-file P registry file --bump-frozen rewrites (tests;
-                      default tools/graftlint/frozen_registry.py)
+    --bump-schema     rewrite tools/graftlint/checkpoint_registry.py
+                      FIELDS from the CURRENT checkpoint-writer AST
+                      (write_only flags of surviving fields preserved)
+    --registry-file P registry file --bump-frozen/--bump-schema rewrite
+                      (tests; defaults to the shipped registry)
+    --no-cache        bypass the incremental result cache
+                      (.graftlint_cache.json); the cache self-
+                      invalidates on any source/rule/registry change
 
 Exit status: 0 when no unsuppressed findings, 1 otherwise, 2 on usage
 errors.
@@ -65,6 +73,33 @@ def _print_hot(targets) -> int:
     return 0
 
 
+def _print_threads(targets) -> int:
+    """The thread-root resolver's verdict: every root (Thread target /
+    executor-dispatched callable) with its provenance, then the set of
+    functions reachable from it — the surface the concurrency rules
+    police."""
+    ctx = load_context(REPO_ROOT, targets)
+    roots = ctx.thread_root_names()
+    for root in roots:
+        info = ctx.functions[root]
+        print(f"{root}  [{info.thread_via}]  "
+              f"{info.module.relpath}:{info.line}")
+        reachable = sorted(
+            f.full_name
+            for f in ctx.threaded_functions()
+            if root in f.thread_roots and f.full_name != root
+        )
+        for name in reachable:
+            g = ctx.functions[name]
+            print(f"    -> {name}  ({g.thread_via})  "
+                  f"{g.module.relpath}:{g.line}")
+    print(
+        f"{len(roots)} thread root(s), "
+        f"{len(ctx.threaded_functions())} thread-reachable function(s)"
+    )
+    return 0
+
+
 def _print_frozen_hashes(targets) -> int:
     from tools.graftlint.frozen_registry import FROZEN
 
@@ -97,6 +132,26 @@ def _bump_frozen(targets, spec: str, registry_file) -> int:
     return 0
 
 
+def _bump_schema(targets, registry_file) -> int:
+    from tools.graftlint.bump import bump_schema
+
+    changed = bump_schema(REPO_ROOT, targets, registry_path=registry_file)
+    if not changed:
+        print("graftlint: checkpoint schema already in sync — no bump needed")
+        return 0
+    for section, (added, removed) in sorted(changed.items()):
+        if added:
+            print(f"{section}: +{sorted(added)}")
+        if removed:
+            print(f"{section}: -{sorted(removed)}")
+    print(
+        "graftlint: checkpoint schema bumped; make the resume path "
+        "consume every new field (or mark it write_only with a reason) "
+        "and re-run the kill -9 resume pin"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="graftlint", add_help=True)
     ap.add_argument("paths", nargs="*", default=None)
@@ -104,9 +159,12 @@ def main(argv=None) -> int:
     ap.add_argument("--select", default=None)
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--hot", action="store_true")
+    ap.add_argument("--threads", action="store_true")
     ap.add_argument("--frozen-hashes", action="store_true")
     ap.add_argument("--bump-frozen", default=None, metavar="NAMES")
+    ap.add_argument("--bump-schema", action="store_true")
     ap.add_argument("--registry-file", default=None)
+    ap.add_argument("--no-cache", action="store_true")
     args = ap.parse_args(argv)
 
     targets = args.paths or list(DEFAULT_TARGETS)
@@ -118,11 +176,25 @@ def main(argv=None) -> int:
             return _print_rules()
         if args.hot:
             return _print_hot(targets)
+        if args.threads:
+            return _print_threads(targets)
         if args.frozen_hashes:
             return _print_frozen_hashes(targets)
         if args.bump_frozen:
             return _bump_frozen(targets, args.bump_frozen, args.registry_file)
-        findings = run_lint(REPO_ROOT, targets, rules=rules)
+        if args.bump_schema:
+            return _bump_schema(targets, args.registry_file)
+        findings = None
+        cache = None
+        if not args.no_cache:
+            from tools.graftlint.cache import LintCache
+
+            cache = LintCache(REPO_ROOT)
+            findings = cache.load(targets, rules)
+        if findings is None:
+            findings = run_lint(REPO_ROOT, targets, rules=rules)
+            if cache is not None:
+                cache.store(targets, rules, findings)
     except (KeyError, ValueError) as e:
         print(f"graftlint: {e.args[0]}", file=sys.stderr)
         return 2

@@ -27,6 +27,9 @@ from typing import Dict, Iterable, Optional, Tuple
 from tools.graftlint.engine import frozen_hash, load_context
 
 DEFAULT_REGISTRY = Path(__file__).resolve().parent / "frozen_registry.py"
+DEFAULT_SCHEMA_REGISTRY = (
+    Path(__file__).resolve().parent / "checkpoint_registry.py"
+)
 
 
 def registered_names(registry_path: Optional[Path] = None):
@@ -124,4 +127,112 @@ def bump_frozen(
             changed[name] = (old, new)
     if changed:
         path.write_text(text)
+    return changed
+
+
+# ------------------------------------------------- checkpoint schema bump
+
+
+def _toplevel_value_span(text: str, name: str):
+    """(begin, end) character offsets of the VALUE of the module-level
+    assignment ``name = <value>`` (AST-located, comment/string-safe)."""
+    import ast
+
+    tree = ast.parse(text)
+    lines = text.splitlines(keepends=True)
+    starts = [0]
+    for ln in lines:
+        starts.append(starts[-1] + len(ln))
+
+    def offset(lineno, col):
+        return starts[lineno - 1] + col
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return (
+                        offset(node.value.lineno, node.value.col_offset),
+                        offset(node.value.end_lineno, node.value.end_col_offset),
+                    )
+    raise KeyError(f"module-level assignment {name!r} not found")
+
+
+def _format_fields(fields: Dict[str, Dict[str, dict]]) -> str:
+    lines = ["{"]
+    for section in ("service", "state", "arrays"):
+        if section not in fields:
+            continue
+        lines.append(f'    "{section}": {{')
+        for fname in sorted(fields[section]):
+            meta = fields[section][fname]
+            lines.append(f'        "{fname}": {meta!r},')
+        lines.append("    },")
+    for section in sorted(set(fields) - {"service", "state", "arrays"}):
+        lines.append(f'    "{section}": {{')
+        for fname in sorted(fields[section]):
+            lines.append(f'        "{fname}": {fields[section][fname]!r},')
+        lines.append("    },")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def bump_schema(
+    repo_root,
+    targets: Iterable[str],
+    registry_path: Optional[Path] = None,
+) -> Dict[str, Tuple[set, set]]:
+    """Rewrite the checkpoint-schema registry's FIELDS block (and
+    SCHEMA_VERSION) from the CURRENT writer AST. The meta dict of every
+    surviving field — ``write_only`` flags and their reasons — is
+    preserved; new fields default to required-on-load. Returns
+    ``{section: (added, removed)}`` for sections that changed (plus a
+    ``"version"`` pseudo-section when the version moved)."""
+    from tools.graftlint.rules.checkpoint_schema import (
+        _module_constant,
+        writer_fields,
+    )
+
+    path = Path(registry_path or DEFAULT_SCHEMA_REGISTRY)
+    text = path.read_text()
+    ns: Dict = {}
+    exec(compile(text, str(path), "exec"), ns)  # registry files are data
+
+    ctx = load_context(Path(repo_root), tuple(targets))
+    changed: Dict[str, Tuple[set, set]] = {}
+    new_fields: Dict[str, Dict[str, dict]] = {}
+    for section, writer_names in ns["WRITERS"].items():
+        infos = [ctx.functions[n] for n in writer_names if n in ctx.functions]
+        if not infos:
+            raise KeyError(
+                f"checkpoint writer(s) {writer_names} for section "
+                f"{section!r} not found in lint targets {tuple(targets)}"
+            )
+        written: set = set()
+        for info in infos:
+            written |= writer_fields(info, section)
+        old = ns["FIELDS"].get(section, {})
+        new_fields[section] = {
+            f: dict(old.get(f, {})) for f in sorted(written)
+        }
+        added = written - set(old)
+        removed = set(old) - written
+        if added or removed:
+            changed[section] = (added, removed)
+
+    new_version = ns["SCHEMA_VERSION"]
+    vconst = _module_constant(ctx, ns["STORAGE_VERSION"])
+    if vconst is not None and vconst[2] is not None:
+        if vconst[2] != ns["SCHEMA_VERSION"]:
+            changed["version"] = ({vconst[2]}, {ns["SCHEMA_VERSION"]})
+            new_version = vconst[2]
+
+    if not changed:
+        return changed
+    begin, end = _toplevel_value_span(text, "FIELDS")
+    text = text[:begin] + _format_fields(new_fields) + text[end:]
+    if "version" in changed:
+        begin, end = _toplevel_value_span(text, "SCHEMA_VERSION")
+        text = text[:begin] + repr(new_version) + text[end:]
+    path.write_text(text)
     return changed

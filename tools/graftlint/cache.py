@@ -1,0 +1,171 @@
+"""graftlint incremental mode: a per-file mtime+content-hash run cache.
+
+``make lint`` runs the whole rule suite on every invocation; as the
+rule count grows (nine families as of the concurrency suite) the
+repo-wide parse+analyze wall starts to matter inside the fast test
+loop. Every finding, however, is a pure function of (engine + rule
+sources, registries, lint targets, rule selection, the catalog doc) —
+so a run whose complete input fingerprint matches the previous one can
+replay its findings without parsing anything.
+
+The fingerprint is per-file: for each input we record
+``(mtime_ns, size, sha256)``. Validation is the classic two-tier check:
+an unchanged ``(mtime_ns, size)`` pair trusts the cached hash without
+reading the file; a changed mtime re-reads and re-hashes — a pure
+``touch`` (same content) refreshes the stored mtime and the cache stays
+valid, so only real edits pay a full run. Any engine/rule/registry
+change invalidates everything (those files are fingerprinted too), as
+does a different target list or ``--select`` set.
+
+The cache lives at ``<repo>/.graftlint_cache.json`` and is used by the
+CLI only (``python -m tools.graftlint``, hence ``make lint``);
+``--no-cache`` bypasses it, and the library entry point ``run_lint``
+stays pure for tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.graftlint.engine import Finding, _iter_target_files
+
+CACHE_BASENAME = ".graftlint_cache.json"
+CACHE_FORMAT = 3
+#: distinct (targets, rule-selection) entries kept; oldest evicted
+MAX_ENTRIES = 8
+
+#: non-target inputs findings depend on: the analyzer itself, the
+#: registries, and the metrics/span catalog document
+def _tool_inputs(repo_root: Path) -> List[Path]:
+    tool_dir = repo_root / "tools" / "graftlint"
+    files = sorted(tool_dir.rglob("*.py")) if tool_dir.is_dir() else []
+    catalog = repo_root / "docs" / "observability.md"
+    if catalog.is_file():
+        files.append(catalog)
+    return files
+
+
+def _fingerprint(path: Path) -> Optional[Tuple[int, int, str]]:
+    try:
+        st = path.stat()
+        digest = hashlib.sha256(path.read_bytes()).hexdigest()
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size, digest)
+
+
+def _stat_pair(path: Path) -> Optional[Tuple[int, int]]:
+    try:
+        st = path.stat()
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
+class LintCache:
+    def __init__(self, repo_root: Path, path: Optional[Path] = None):
+        self.repo_root = Path(repo_root)
+        self.path = Path(path) if path else self.repo_root / CACHE_BASENAME
+
+    def _key(self, targets: Iterable[str], rules) -> str:
+        spec = {
+            "targets": list(targets),
+            "rules": sorted(rules) if rules else None,
+            "format": CACHE_FORMAT,
+        }
+        return hashlib.sha256(
+            json.dumps(spec, sort_keys=True).encode()
+        ).hexdigest()
+
+    def _input_files(self, targets) -> List[Path]:
+        files = list(_iter_target_files(self.repo_root, targets))
+        files.extend(_tool_inputs(self.repo_root))
+        return files
+
+    def _read(self) -> Optional[dict]:
+        try:
+            data = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return None
+        if data.get("format") != CACHE_FORMAT:
+            return None
+        if not isinstance(data.get("entries"), dict):
+            return None
+        return data
+
+    def load(self, targets, rules) -> Optional[List[Finding]]:
+        """Cached findings when every fingerprint of this run key's
+        entry matches, else None. Entries are keyed by (targets, rule
+        selection), so `make lint`, `make lint-threads` and the tier-1
+        cache test each keep their own slot instead of evicting each
+        other. Touch-only changes (new mtime, identical content)
+        revalidate and refresh the stored mtime in place."""
+        data = self._read()
+        if data is None:
+            return None
+        entry = data["entries"].get(self._key(targets, rules))
+        if entry is None:
+            return None
+        stored: Dict[str, list] = entry.get("files", {})
+        try:
+            current = self._input_files(targets)
+        except ValueError:
+            return None
+        if {str(p) for p in current} != set(stored):
+            return None
+        refreshed = False
+        for p in current:
+            mtime_ns, size, digest = stored[str(p)]
+            pair = _stat_pair(p)
+            if pair is None:
+                return None
+            if pair == (mtime_ns, size):
+                continue  # fast path: stat matches, trust the hash
+            fp = _fingerprint(p)
+            if fp is None or fp[2] != digest:
+                return None  # real edit
+            stored[str(p)] = list(fp)  # touch: refresh the mtime
+            refreshed = True
+        if refreshed:
+            self._write(data)
+        return [Finding(**f) for f in entry.get("findings", [])]
+
+    def store(self, targets, rules, findings: List[Finding]) -> None:
+        try:
+            files = {
+                str(p): list(fp)
+                for p in self._input_files(targets)
+                for fp in [_fingerprint(p)]
+                if fp is not None
+            }
+        except ValueError:
+            return
+        data = self._read() or {"format": CACHE_FORMAT, "entries": {}}
+        entries = data["entries"]
+        key = self._key(targets, rules)
+        entries.pop(key, None)  # re-insert so eviction order is LRU-ish
+        entries[key] = {
+            "files": files,
+            "findings": [_finding_dict(f) for f in findings],
+        }
+        while len(entries) > MAX_ENTRIES:
+            entries.pop(next(iter(entries)))
+        self._write(data)
+
+    def _write(self, data) -> None:
+        try:
+            tmp = self.path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(data))
+            tmp.replace(self.path)
+        except OSError:
+            pass  # a cache that cannot be written is just a miss
+
+
+def _finding_dict(f: Finding) -> dict:
+    d = dataclasses.asdict(f)
+    # Finding fields only — forward-compatible with suppression state
+    return d

@@ -1,0 +1,93 @@
+"""The service-checkpoint schema registry: the frozen field set of the
+crash-safe checkpoint payload (see rules/checkpoint_schema.py for the
+cross-check and docs/concurrency.md for the bump procedure).
+
+The PR 10 incident this freezes: ``optimizer_draws`` was written by
+``_tenant_checkpoint`` but its read was nearly dropped from the resume
+path in review — a field asymmetry that silently breaks bitwise resume.
+Every field written on the ``save_service_checkpoint_to_h5`` path must
+be consumed on the ``load_service_checkpoint_from_h5``/``resume`` path
+and vice versa; ``write_only: True`` marks the deliberate exceptions
+(informational fields a resume never needs).
+
+Bump procedure: edit the save/load paths together, then run
+``python -m tools.graftlint --bump-schema`` — it rewrites FIELDS from
+the CURRENT writer AST (preserving ``write_only`` flags of surviving
+fields) and updates SCHEMA_VERSION to match
+``storage.SERVICE_CHECKPOINT_VERSION``. A new field defaults to
+required-on-load; mark it ``write_only`` only with a reason, and bump
+``SERVICE_CHECKPOINT_VERSION`` in storage.py when the layout change is
+incompatible.
+"""
+
+#: must equal storage.SERVICE_CHECKPOINT_VERSION (cross-checked)
+SCHEMA_VERSION = 1
+
+#: where the payload is WRITTEN: section -> producer functions whose
+#: dict literals / subscript stores define the field set
+WRITERS = {
+    "service": ["dmosopt_tpu.service.OptimizationService._checkpoint_payload"],
+    "state": ["dmosopt_tpu.service.OptimizationService._tenant_checkpoint"],
+    "arrays": ["dmosopt_tpu.service.OptimizationService._tenant_checkpoint"],
+}
+
+#: where the payload is CONSUMED: every non-write_only field must be
+#: read (``st["f"]`` / ``st.get("f")``) in at least one of these
+READERS = [
+    "dmosopt_tpu.service.OptimizationService._apply_restore",
+    "dmosopt_tpu.service.OptimizationService.resume",
+    "dmosopt_tpu.service.OptimizationService.submit",
+]
+
+#: the frozen field sets; ``write_only`` fields are persisted for
+#: humans/tools but deliberately never read back by resume — each
+#: carries its reason (``--bump-schema`` regenerates this block,
+#: preserving the meta of surviving fields)
+FIELDS = {
+    "service": {
+        "min_bucket": {},
+        "steps": {"write_only": True,
+                  "reason": "service step counter, informational"},
+        "ts": {"write_only": True,
+               "reason": "snapshot wall-clock, informational"},
+    },
+    "state": {
+        "cost_seconds": {},
+        "degraded": {},
+        "epoch_index": {},
+        "epochs_run": {},
+        "eval_failures": {},
+        "failed_epochs": {},
+        "n_epochs": {"write_only": True,
+                     "reason": "duplicated in the submit config resume "
+                               "rebuilds from; stored for introspection"},
+        "opt_id": {},
+        "optimizer_draws": {},
+        "pred_width": {"write_only": True,
+                       "reason": "load path re-derives the width from "
+                                 "the pending_pred array shape"},
+        "quarantined": {},
+        "quarantined_seen": {},
+        "refit": {},
+        "rng_state": {},
+        "tenant_id": {},
+    },
+    "arrays": {
+        "c": {},
+        "f": {},
+        "pending_epoch": {},
+        "pending_has_pred": {},
+        "pending_pred": {},
+        "pending_x": {},
+        "t": {},
+        "x": {},
+        "y": {},
+    },
+}
+
+#: the storage-side array allowlist must match FIELDS["arrays"] exactly
+#: (an array the service writes but storage drops is a silent data loss)
+STORAGE_ARRAYS = "dmosopt_tpu.storage._CHECKPOINT_ARRAYS"
+
+#: the storage-side version constant SCHEMA_VERSION mirrors
+STORAGE_VERSION = "dmosopt_tpu.storage.SERVICE_CHECKPOINT_VERSION"

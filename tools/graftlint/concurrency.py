@@ -1,0 +1,602 @@
+"""Shared concurrency model for the thread-aware graftlint rules.
+
+Built once per `LintContext` (cached on the context) and consumed by
+``shared-state-guard``, ``lock-discipline`` and ``resource-lifecycle``:
+
+- **lock discovery**: instance attributes assigned
+  ``threading.Lock()``/``RLock()``/``Condition()``/``Semaphore()`` and
+  module-level names bound to the same, each with a canonical *lock id*
+  (``pkg.mod.Class._lock`` / ``pkg.mod.LOCK``). Attributes assigned
+  intrinsically thread-safe types (``queue.Queue``, ``threading.Event``,
+  executors, ``threading.local``) are discovered too — the shared-state
+  rule exempts them.
+- **lexical lock regions**: per function, every ``with <lock>:`` region
+  and the tuple of lock ids held at each interesting node (attribute
+  access, call, manual ``acquire()``), plus lock-ordering edges and
+  same-lock nestings.
+- **caller-holds-lock propagation**: the repo's documented "caller
+  holds ``self._lock``" idiom, computed instead of trusted — a function
+  is *entry-locked* on L when EVERY analyzed call site runs with L held
+  (lexically or itself entry-locked). Thread targets are never
+  entry-locked: a spawn does not inherit the spawner's locks.
+- **execution contexts**: per function, the set of thread roots it is
+  reachable from (engine thread-root resolver) plus ``<main>`` when it
+  is reachable from non-threaded code (public API, module scope).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    FunctionInfo,
+    LintContext,
+    _function_targets,
+)
+
+#: lock constructors (RLock is reentrant — same-lock nesting is legal)
+LOCK_TYPES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "multiprocessing.Lock", "multiprocessing.RLock",
+}
+REENTRANT_TYPES = {"threading.RLock", "multiprocessing.RLock"}
+
+#: intrinsically thread-safe attribute types — exempt from the
+#: shared-state guard (their own synchronization is the guard)
+THREADSAFE_TYPES = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+    "threading.Event", "threading.local", "threading.Barrier",
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+}
+
+#: attribute-method calls that MUTATE their receiver in place — a
+#: ``self.X.append(...)`` is a write to the shared container X
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "add", "update",
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+    "insert", "setdefault", "sort", "reverse", "put", "put_nowait",
+}
+
+#: method qualname tails whose writes are construction-time — they
+#: happen before any thread can observe the object
+INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+MAIN = "<main>"
+
+
+@dataclasses.dataclass
+class Access:
+    """One shared-state touch: ``self.attr`` or a module global."""
+
+    owner: str  # canonical owner id (class component root / module)
+    name: str  # attribute or global name
+    fn: FunctionInfo
+    node: ast.AST
+    write: bool
+    held: Tuple[str, ...]  # lexically held lock ids at the node
+
+
+@dataclasses.dataclass
+class CallSite:
+    targets: List[str]
+    node: ast.Call
+    held: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class FnConc:
+    """Per-function lexical concurrency facts."""
+
+    regions: List[Tuple[str, ast.AST]] = dataclasses.field(default_factory=list)
+    order_edges: List[Tuple[str, str, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+    same_lock_nesting: List[Tuple[str, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+    acquires: List[Tuple[Optional[str], ast.AST, bool, Tuple[str, ...]]] = (
+        dataclasses.field(default_factory=list)
+    )  # (lock id, node, release-protected, held)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    attr_accesses: List[Access] = dataclasses.field(default_factory=list)
+    global_accesses: List[Access] = dataclasses.field(default_factory=list)
+    blocking: List[Tuple[str, ast.AST, Tuple[str, ...]]] = dataclasses.field(
+        default_factory=list
+    )
+    #: lock ids released in ANY try/finally of the function — the
+    #: classic acquire-before-try form counts as release-protected
+    finally_releases: Set[str] = dataclasses.field(default_factory=set)
+
+
+#: canonical names whose call blocks the calling thread
+BLOCKING_CANON = {
+    "time.sleep": "time.sleep()",
+    "h5py.File": "h5py.File() (file IO)",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "subprocess.Popen": "subprocess.Popen()",
+}
+#: attribute-call names that block (joins, future results, cond waits);
+#: excluded when the receiver is plainly a string/path join
+BLOCKING_ATTRS = {"result", "join", "wait", "acquire"}
+_JOIN_EXCLUDE_CANON = {"os.path.join", "posixpath.join", "ntpath.join",
+                       "str.join", "shlex.join", "bytes.join"}
+
+
+class ConcurrencyModel:
+    def __init__(self, ctx: LintContext):
+        self.ctx = ctx
+        # lock/thread-safe/queue attribute discovery
+        self.class_lock_attrs: Dict[str, Dict[str, str]] = {}  # cls -> {attr: ctor}
+        self.class_safe_attrs: Dict[str, Set[str]] = {}
+        self.class_queue_attrs: Dict[str, Set[str]] = {}
+        self.module_locks: Dict[str, Dict[str, str]] = {}  # mod -> {name: ctor}
+        self._discover_locks()
+        self.lock_ctor: Dict[str, str] = {}
+        for cls, attrs in self.class_lock_attrs.items():
+            for attr, ctor in attrs.items():
+                self.lock_ctor[f"{cls}.{attr}"] = ctor
+        for modname, names in self.module_locks.items():
+            for name, ctor in names.items():
+                self.lock_ctor[f"{modname}.{name}"] = ctor
+        # class components: self.attr storage is shared across the
+        # hierarchy, so accesses group under one canonical owner
+        self._owner_cache: Dict[str, str] = {}
+        # per-function lexical walk
+        self.fn_conc: Dict[str, FnConc] = {}
+        for info in ctx.functions.values():
+            self.fn_conc[info.full_name] = _walk_function(self, info)
+        # caller-holds-lock propagation and main-path reachability
+        self.entry_locks = self._compute_entry_locks()
+        self.main_set = self._compute_main_set()
+
+    # ------------------------------------------------------------ locks
+
+    def _discover_locks(self):
+        ctx = self.ctx
+        for mod in ctx.modules:
+            # module-level NAME = threading.Lock()
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call
+                ):
+                    ctor = mod.resolve(stmt.value.func)
+                    if ctor in LOCK_TYPES:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                self.module_locks.setdefault(
+                                    mod.modname, {}
+                                )[t.id] = ctor
+            # self.X = threading.Lock() / queue.Queue() / ... anywhere
+            # in a method body (usually __init__, but lazily-created
+            # pools count too). AnnAssign and conditional-expression
+            # values (`x if cond else None`) are unwrapped.
+            for info in mod.functions.values():
+                if not info.class_name:
+                    continue
+                cls = f"{mod.modname}.{info.class_name}"
+                for node in ast.walk(info.node):
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif (
+                        isinstance(node, ast.AnnAssign)
+                        and node.value is not None
+                    ):
+                        targets, value = [node.target], node.value
+                    else:
+                        continue
+                    ctors = {
+                        mod.resolve(sub.func)
+                        for sub in ast.walk(value)
+                        if isinstance(sub, ast.Call)
+                    }
+                    for t in targets:
+                        if not (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in ("self", "cls")
+                        ):
+                            continue
+                        for ctor in ctors:
+                            if ctor in LOCK_TYPES:
+                                self.class_lock_attrs.setdefault(
+                                    cls, {}
+                                )[t.attr] = ctor
+                            elif ctor in THREADSAFE_TYPES:
+                                self.class_safe_attrs.setdefault(
+                                    cls, set()
+                                ).add(t.attr)
+                                if (ctor or "").startswith("queue."):
+                                    self.class_queue_attrs.setdefault(
+                                        cls, set()
+                                    ).add(t.attr)
+
+    def is_reentrant(self, lock_id: str) -> bool:
+        return self.lock_ctor.get(lock_id) in REENTRANT_TYPES
+
+    def _class_component(self, cls: str) -> str:
+        """Canonical owner for a class: the lexicographically smallest
+        member of its relatives closure (self.attr storage is shared
+        across the hierarchy)."""
+        cached = self._owner_cache.get(cls)
+        if cached is None:
+            rel = self.ctx.class_relatives.get(cls, {cls})
+            cached = self._owner_cache[cls] = min(rel | {cls})
+        return cached
+
+    def lock_id(self, info: FunctionInfo, expr: ast.AST) -> Optional[str]:
+        """Canonical lock id for a with-item / acquire receiver, or
+        None when the expression is not lock-like. Known lock attrs and
+        module locks match structurally; otherwise a name containing
+        'lock'/'mutex' is accepted (fixture-friendly fallback)."""
+        mod = info.module
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")
+            and info.class_name
+        ):
+            own = f"{mod.modname}.{info.class_name}"
+            for cls in sorted(self.ctx.class_relatives.get(own, {own}) | {own}):
+                if expr.attr in self.class_lock_attrs.get(cls, {}):
+                    return f"{cls}.{expr.attr}"
+            if "lock" in expr.attr.lower() or "mutex" in expr.attr.lower():
+                return f"{own}.{expr.attr}"
+            return None
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            canon = mod.resolve(expr)
+            if canon is not None:
+                # a bare in-module name resolves unqualified: anchor it
+                # to this module (the module_locks/lock_ctor key shape)
+                if "." not in canon:
+                    qualified = f"{mod.modname}.{canon}"
+                    if canon in self.module_locks.get(mod.modname, {}):
+                        return qualified
+                    if "lock" in canon.lower() or "mutex" in canon.lower():
+                        return qualified
+                    return None
+                if canon in self.lock_ctor:
+                    return canon
+                for modname, names in self.module_locks.items():
+                    # import-aliased module lock (re-exported)
+                    resolved = self.ctx.resolve_symbol(
+                        canon, {f"{modname}.{n}": 1 for n in names}
+                    )
+                    if resolved:
+                        return resolved
+                leaf = canon.split(".")[-1].lower()
+                if "lock" in leaf or "mutex" in leaf:
+                    return canon
+        return None
+
+    # --------------------------------------------- entry-lock propagation
+
+    def _compute_entry_locks(self) -> Dict[str, FrozenSet[str]]:
+        sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for fname, conc in self.fn_conc.items():
+            for cs in conc.calls:
+                for t in cs.targets:
+                    sites.setdefault(t, []).append(
+                        (fname, frozenset(cs.held))
+                    )
+        all_locks = frozenset(self.lock_ctor) | {
+            lid
+            for conc in self.fn_conc.values()
+            for lid, _ in conc.regions
+        }
+        entry: Dict[str, FrozenSet[str]] = {}
+        for name, info in self.ctx.functions.items():
+            if info.thread_target or name not in sites:
+                entry[name] = frozenset()
+            else:
+                entry[name] = all_locks  # TOP; intersection-refined below
+        for _ in range(len(self.ctx.functions) + 2):
+            changed = False
+            for name, slist in sites.items():
+                info = self.ctx.functions.get(name)
+                if info is None or info.thread_target:
+                    continue
+                new: Optional[FrozenSet[str]] = None
+                for caller, held in slist:
+                    eff = held | entry.get(caller, frozenset())
+                    new = eff if new is None else (new & eff)
+                new = new or frozenset()
+                if new != entry[name]:
+                    entry[name] = new
+                    changed = True
+            if not changed:
+                break
+        return entry
+
+    def held_at(self, fn: FunctionInfo, lexical: Tuple[str, ...]) -> FrozenSet[str]:
+        """Effective lock set at a node: lexical `with` nesting plus the
+        locks provably held at every entry to the function."""
+        return frozenset(lexical) | self.entry_locks.get(
+            fn.full_name, frozenset()
+        )
+
+    # ----------------------------------------------- main-path contexts
+
+    def _compute_main_set(self) -> Set[str]:
+        ctx = self.ctx
+        callers: Dict[str, int] = {}
+        for f in ctx.functions.values():
+            for name in f.calls:
+                callers[name] = callers.get(name, 0) + 1
+        children: Dict[FunctionInfo, List[FunctionInfo]] = {}
+        for f in ctx.functions.values():
+            if f.parent is not None:
+                children.setdefault(f.parent, []).append(f)
+        main: Set[str] = set()
+        work: List[FunctionInfo] = []
+        for f in ctx.functions.values():
+            # seeds: top-level defs/methods nobody in the analyzed set
+            # calls — invocable from outside (public API, tests, module
+            # scope) — that are not thread spawn targets
+            if f.thread_target or f.parent is not None:
+                continue
+            if not callers.get(f.full_name):
+                main.add(f.full_name)
+                work.append(f)
+        while work:
+            f = work.pop()
+            nxt: List[FunctionInfo] = []
+            for name in f.calls:
+                g = ctx.functions.get(name)
+                if g is not None:
+                    nxt.append(g)
+            nxt.extend(children.get(f, ()))
+            for g in nxt:
+                if g.thread_target or g.full_name in main:
+                    continue
+                main.add(g.full_name)
+                work.append(g)
+        return main
+
+    def contexts(self, fn: FunctionInfo) -> FrozenSet[str]:
+        """Execution contexts this function's body can run in: the
+        thread roots it is reachable from, plus ``<main>`` when it is
+        reachable outside any spawned thread."""
+        out = set(fn.thread_roots)
+        if fn.full_name in self.main_set or not out:
+            out.add(MAIN)
+        return frozenset(out)
+
+    # -------------------------------------------------- owner utilities
+
+    def attr_owner(self, info: FunctionInfo) -> Optional[str]:
+        if not info.class_name:
+            return None
+        return self._class_component(
+            f"{info.module.modname}.{info.class_name}"
+        )
+
+    def exempt_attr(self, info: FunctionInfo, attr: str) -> bool:
+        """Lock attributes and intrinsically thread-safe containers are
+        not shared-state findings."""
+        own = f"{info.module.modname}.{info.class_name}"
+        for cls in self.ctx.class_relatives.get(own, {own}) | {own}:
+            if attr in self.class_lock_attrs.get(cls, {}):
+                return True
+            if attr in self.class_safe_attrs.get(cls, set()):
+                return True
+        return False
+
+
+def get_model(ctx: LintContext) -> ConcurrencyModel:
+    model = getattr(ctx, "_concurrency_model", None)
+    if model is None:
+        model = ConcurrencyModel(ctx)
+        ctx._concurrency_model = model
+    return model
+
+
+# -------------------------------------------------------------- walker
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+    ):
+        return node.attr
+    return None
+
+
+def _walk_function(model: ConcurrencyModel, info: FunctionInfo) -> FnConc:
+    """One pass over a function's own body (nested defs/lambdas are
+    separate scopes) tracking the lexical lock stack."""
+    out = FnConc()
+    mod = info.module
+    ctx = model.ctx
+
+    # names bound locally (shadowing module globals), minus `global`s
+    if isinstance(info.node, ast.Module):
+        local_names: Set[str] = set()
+        global_decls: Set[str] = set()
+        body = list(info.node.body)
+    else:
+        from tools.graftlint.engine import _function_scope_locals
+
+        global_decls = {
+            n
+            for sub in ast.walk(info.node)
+            for n in (sub.names if isinstance(sub, ast.Global) else ())
+        }
+        local_names = _function_scope_locals(info.node) - global_decls
+        body = info.node.body if isinstance(info.node.body, list) else [
+            info.node.body
+        ]
+
+    tracked_globals = {
+        n
+        for n in mod.global_names
+        if n not in mod.aliases
+        and f"{mod.modname}.{n}" not in ctx.functions
+        and f"{mod.modname}.{n}" not in ctx.classes
+    }
+
+    def record_attr(attr: str, node: ast.AST, write: bool, held):
+        if not info.class_name:
+            return
+        if model.exempt_attr(info, attr):
+            return
+        owner = model.attr_owner(info)
+        if owner is None:
+            return
+        out.attr_accesses.append(
+            Access(owner, attr, info, node, write, tuple(held))
+        )
+
+    def record_global(name: str, node: ast.AST, write: bool, held):
+        if name not in tracked_globals:
+            return
+        if name in local_names and name not in global_decls:
+            return
+        out.global_accesses.append(
+            Access(mod.modname, name, info, node, write, tuple(held))
+        )
+
+    def handle_call(node: ast.Call, held):
+        canon = mod.resolve(node.func)
+        targets = _function_targets(ctx, info, node.func)
+        if targets:
+            out.calls.append(CallSite(targets, node, tuple(held)))
+        # manual acquire / blocking calls
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = node.func.value
+            if attr == "acquire":
+                lid = model.lock_id(info, recv)
+                if lid is not None:
+                    out.acquires.append((lid, node, False, tuple(held)))
+                    return
+            if attr in BLOCKING_ATTRS and attr != "acquire":
+                if canon in _JOIN_EXCLUDE_CANON:
+                    return_block = False
+                elif isinstance(recv, ast.Constant):
+                    return_block = False  # "sep".join(...)
+                else:
+                    return_block = True
+                if return_block:
+                    out.blocking.append(
+                        (f".{attr}()", node, tuple(held))
+                    )
+                return
+            if attr in ("get",) and info.class_name:
+                # blocking Queue.get on a known queue attribute
+                rattr = _self_attr(recv)
+                own = f"{mod.modname}.{info.class_name}"
+                if rattr is not None and any(
+                    rattr in model.class_queue_attrs.get(cls, set())
+                    for cls in ctx.class_relatives.get(own, {own}) | {own}
+                ):
+                    out.blocking.append(
+                        (f"Queue.get() on self.{rattr}", node, tuple(held))
+                    )
+                return
+        if canon in BLOCKING_CANON:
+            out.blocking.append((BLOCKING_CANON[canon], node, tuple(held)))
+        elif (
+            isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+            and "open" not in mod.aliases
+            and "open" not in local_names
+        ):
+            out.blocking.append(("open() (file IO)", node, tuple(held)))
+
+    def visit(node: ast.AST, held: Tuple[str, ...], released: Tuple[str, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # separate scope, walked via its own FunctionInfo
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                visit(item.context_expr, held, released)
+                lid = model.lock_id(info, item.context_expr)
+                if lid is not None:
+                    if lid in new_held and not model.is_reentrant(lid):
+                        out.same_lock_nesting.append((lid, node))
+                    for h in new_held:
+                        if h != lid:
+                            out.order_edges.append((h, lid, node))
+                    out.regions.append((lid, node))
+                    new_held = new_held + (lid,)
+            for sub in node.body:
+                visit(sub, new_held, released)
+            return
+        if isinstance(node, ast.Try):
+            rel = set(released)
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "release"
+                    ):
+                        lid = model.lock_id(info, sub.func.value)
+                        if lid is not None:
+                            rel.add(lid)
+                            out.finally_releases.add(lid)
+            rel_t = tuple(rel)
+            for sub in node.body + node.handlers + node.orelse:
+                visit(sub, held, rel_t)
+            for sub in node.finalbody:
+                visit(sub, held, released)
+            return
+        if isinstance(node, ast.ExceptHandler):
+            for sub in node.body:
+                visit(sub, held, released)
+            return
+        if isinstance(node, ast.Call):
+            handle_call(node, held)
+            # patch release-protection onto the acquire just recorded
+            if (
+                out.acquires
+                and out.acquires[-1][1] is node
+                and out.acquires[-1][0] in released
+            ):
+                lid, n, _, h = out.acquires[-1]
+                out.acquires[-1] = (lid, n, True, h)
+            # mutating method call on self.attr / a module global
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr in MUTATOR_METHODS
+            ):
+                recv = node.func.value
+                attr = _self_attr(recv)
+                if attr is not None:
+                    record_attr(attr, node, True, held)
+                elif isinstance(recv, ast.Name):
+                    record_global(recv.id, node, True, held)
+        elif isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                record_attr(attr, node, write, held)
+        elif isinstance(node, ast.Subscript):
+            # self.X[i] = v / del GLOBAL[k]: container mutation
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                attr = _self_attr(node.value)
+                if attr is not None:
+                    record_attr(attr, node, True, held)
+                elif isinstance(node.value, ast.Name):
+                    record_global(node.value.id, node, True, held)
+        elif isinstance(node, ast.Name):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                if node.id in global_decls:
+                    record_global(node.id, node, True, held)
+            elif isinstance(node.ctx, ast.Load):
+                record_global(node.id, node, False, held)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, released)
+
+    for stmt in body:
+        visit(stmt, (), ())
+    return out

@@ -25,6 +25,14 @@ The resolver is deliberately an over-approximation: a function that is
 hot, because the traced call is the one that breaks. Deliberate
 exceptions (e.g. tracer-guarded eager-only telemetry) carry a
 ``# graftlint: disable=<rule> -- why`` suppression.
+
+A symmetric **thread-root resolver** feeds the concurrency rules:
+functions passed to ``threading.Thread(target=...)`` or an executor
+``.submit``/``.map`` dispatch (directly, through ``functools.partial``,
+or forwarded through a dispatcher parameter like the service's
+``_submit_write``) are roots, and reachability unions root sets over
+the same call graph — ``--threads`` prints the verdict. See
+docs/concurrency.md for the threading model the current tree has.
 """
 
 from __future__ import annotations
@@ -68,6 +76,20 @@ SWITCH_LIKE = {"jax.lax.switch"}
 
 #: method names that register traced fwd/bwd rules on a custom_vjp fn
 DERIV_REGISTER_METHODS = {"defvjp", "defjvp"}
+
+#: constructors whose ``target=`` callable runs on a NEW host thread —
+#: the seeds of the thread-root resolver (the concurrency rules'
+#: counterpart of the jit-region resolver)
+THREAD_SPAWNERS = {"threading.Thread", "threading.Timer"}
+
+#: attribute-call method names that dispatch their first callable
+#: argument onto a worker thread (``ThreadPoolExecutor.submit``/``map``,
+#: ``BackgroundWriter.submit`` — duck-typed: the receiver's class is
+#: usually not statically known, so any ``.submit(fn, ...)``/
+#: ``.map(fn, ...)`` whose first argument resolves to an analyzed
+#: function is treated as a thread dispatch; jax combinators are
+#: excluded by canonical name)
+THREAD_DISPATCH_METHODS = {"submit", "map", "apply_async"}
 
 _DIRECTIVE_RE = re.compile(
     r"#\s*graftlint:\s*disable=([A-Za-z0-9_,-]+)"
@@ -166,6 +188,19 @@ class FunctionInfo:
     refs: Set[str] = dataclasses.field(default_factory=set)
     hot: bool = False
     hot_via: str = ""  # provenance, for messages and --hot output
+    # ---- thread-root resolver marks (the concurrency rules' input)
+    thread_target: bool = False  # passed to Thread(target=)/pool.submit
+    thread_via: str = ""  # provenance, for messages and --threads output
+    #: full names of the thread-root functions this one is reachable
+    #: from (a thread target is its own root); empty = main-path only
+    thread_roots: Set[str] = dataclasses.field(default_factory=set)
+    #: parameter names this function forwards to a thread dispatch —
+    #: callers passing a function here are spawning it on a thread
+    dispatch_params: Set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def threaded(self) -> bool:
+        return bool(self.thread_roots)
 
     @property
     def full_name(self) -> str:
@@ -345,6 +380,10 @@ class LintContext:
         self.class_relatives: Dict[str, Set[str]] = {}
         self.parse_errors: List[Finding] = []
         self.options: Dict[str, object] = {}  # per-run rule overrides
+        # call records kept for the dispatcher pass: (caller info, Call
+        # node) for every call that passes at least one analyzed
+        # function as an argument
+        self._call_records: List[Tuple[FunctionInfo, ast.Call]] = []
 
     # ------------------------------------------------------- building
 
@@ -370,7 +409,8 @@ class LintContext:
             self.functions[info.full_name] = info
 
     def finalize(self):
-        """Resolve the call graph and propagate jit-region marks."""
+        """Resolve the call graph and propagate jit-region and
+        thread-root marks."""
         self._build_class_relatives()
         for mod in self.modules:
             for info in mod.functions.values():
@@ -380,7 +420,9 @@ class LintContext:
             # the synthetic scope itself is eager import-time code, so
             # its call/ref edges are discarded — only the marks stick
             _collect_edges(self, module_scope(mod))
+        self._resolve_dispatchers()
         self._propagate_hot()
+        self._propagate_threads()
 
     def resolve_symbol(self, dotted: Optional[str], index: Dict[str, object]) -> Optional[str]:
         """Chase package re-exports: ``dmosopt_tpu.ops.non_dominated_rank``
@@ -458,10 +500,91 @@ class LintContext:
                     g.hot_via = via
                     work.append(g)
 
+    def _resolve_dispatchers(self):
+        """Second pass over recorded calls: a call passing an analyzed
+        function to a *dispatcher* — a function that forwards one of its
+        own parameters to a thread-dispatch form (the service's
+        ``_submit_write(fn, ...)`` -> ``self._writer.submit(fn, ...)``
+        pattern) — spawns that function on a thread. A call forwarding
+        the CALLER's own parameter to a dispatcher makes the caller a
+        dispatcher too, so the loop iterates until no new root or
+        dispatcher param appears (dispatcher-of-dispatcher chains)."""
+        for _ in range(len(self.functions) + 2):
+            changed = False
+            for info, node in self._call_records:
+                for callee in _function_targets(self, info, node.func):
+                    g = self.functions.get(callee)
+                    if g is None or not g.dispatch_params:
+                        continue
+                    for expr in _args_bound_to(g, node, g.dispatch_params):
+                        for t in _spawn_targets(self, info, expr):
+                            fi = self.functions[t]
+                            if not fi.thread_target:
+                                fi.thread_target = True
+                                fi.thread_via = (
+                                    f"dispatched through {g.full_name} "
+                                    f"from {info.full_name}"
+                                )
+                                changed = True
+                        # a bare parameter of the CALLER forwarded into
+                        # a dispatcher: the caller dispatches too
+                        pname = _own_param_name(info, expr)
+                        if (
+                            pname is not None
+                            and pname not in info.dispatch_params
+                        ):
+                            info.dispatch_params.add(pname)
+                            changed = True
+            if not changed:
+                return
+
+    def _propagate_threads(self):
+        """Mirror of `_propagate_hot` for the thread-root resolver:
+        every thread target is its own root; reachability (calls, refs,
+        nested defs) unions root sets until fixpoint, so a function
+        reachable from two different thread roots carries both."""
+        children: Dict[FunctionInfo, List[FunctionInfo]] = {}
+        for f in self.functions.values():
+            if f.parent is not None:
+                children.setdefault(f.parent, []).append(f)
+        work: List[FunctionInfo] = []
+        for info in self.functions.values():
+            if info.thread_target:
+                info.thread_roots.add(info.full_name)
+                work.append(info)
+        while work:
+            f = work.pop()
+            targets: List[FunctionInfo] = []
+            for g in children.get(f, ()):
+                # a def nested in a threaded function runs on that
+                # thread — unless it is itself a spawn target (its own
+                # root, e.g. the dedicated-retry `run` closures)
+                if not g.thread_target:
+                    targets.append(g)
+            for name in f.calls | f.refs:
+                g = self.functions.get(name)
+                if g is not None:
+                    targets.append(g)
+            for g in targets:
+                before = len(g.thread_roots)
+                g.thread_roots |= f.thread_roots
+                if len(g.thread_roots) != before:
+                    if not g.thread_via:
+                        g.thread_via = f"reached from {f.full_name}"
+                    work.append(g)
+
     # -------------------------------------------------------- queries
 
     def hot_functions(self) -> List[FunctionInfo]:
         return [f for f in self.functions.values() if f.hot]
+
+    def threaded_functions(self) -> List[FunctionInfo]:
+        return [f for f in self.functions.values() if f.threaded]
+
+    def thread_root_names(self) -> List[str]:
+        return sorted(
+            f.full_name for f in self.functions.values() if f.thread_target
+        )
 
     def resolve_call(self, mod: Module, node: ast.Call) -> Optional[str]:
         """Canonical dotted name of a call's target (import-aliased)."""
@@ -685,6 +808,87 @@ def _function_targets(
     return []
 
 
+def _spawn_targets(
+    ctx: LintContext, info: FunctionInfo, node: ast.AST
+) -> List[str]:
+    """`_function_targets` for a thread-dispatch callable argument,
+    additionally unwrapping ``functools.partial(fn, ...)`` — the common
+    ``pool.submit(partial(work, cfg))`` form."""
+    if isinstance(node, ast.Call):
+        fr = info.module.resolve(node.func)
+        if fr in ("functools.partial", "partial"):
+            return (
+                _spawn_targets(ctx, info, node.args[0]) if node.args else []
+            )
+    return _function_targets(ctx, info, node)
+
+
+def _param_names(info: FunctionInfo) -> List[str]:
+    """Positional parameter names of a def, with a leading self/cls
+    dropped for methods (callers never pass it explicitly)."""
+    if isinstance(info.node, ast.Lambda):
+        args = info.node.args
+    else:
+        args = info.node.args
+    names = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+    if info.class_name and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _args_bound_to(
+    callee: FunctionInfo, call: ast.Call, params: Set[str]
+) -> List[ast.AST]:
+    """The argument expressions of `call` that bind to `params` of
+    `callee` (positional by position, keyword by name)."""
+    out: List[ast.AST] = []
+    names = _param_names(callee)
+    for i, arg in enumerate(call.args):
+        if i < len(names) and names[i] in params:
+            out.append(arg)
+    for kw in call.keywords:
+        if kw.arg in params:
+            out.append(kw.value)
+    return out
+
+
+def _own_param_name(info: FunctionInfo, expr: ast.AST) -> Optional[str]:
+    """The parameter of `info` that `expr` is (a bare Name, possibly
+    inside a ``functools.partial(...)`` wrapper), or None."""
+    if isinstance(info.node, ast.Module):
+        return None
+    inner = expr
+    if isinstance(inner, ast.Call):  # partial(fn, ...): look at fn
+        fr = info.module.resolve(inner.func)
+        if fr in ("functools.partial", "partial") and inner.args:
+            inner = inner.args[0]
+    if not isinstance(inner, ast.Name):
+        return None
+    own_params = [a.arg for a in (
+        list(info.node.args.posonlyargs) + list(info.node.args.args)
+        + list(info.node.args.kwonlyargs)
+    )]
+    return inner.id if inner.id in own_params else None
+
+
+def _mark_spawned(
+    ctx: LintContext, info: FunctionInfo, expr: ast.AST, via: str
+) -> bool:
+    """Mark every function `expr` resolves to as a thread target;
+    returns True when `expr` is instead a bare parameter of `info`
+    (making `info` a dispatcher for that parameter)."""
+    for t in _spawn_targets(ctx, info, expr):
+        fi = ctx.functions[t]
+        if not fi.thread_target:
+            fi.thread_target = True
+            fi.thread_via = via
+    pname = _own_param_name(info, expr)
+    if pname is not None:
+        info.dispatch_params.add(pname)
+        return True
+    return False
+
+
 def _collect_edges(ctx: LintContext, info: FunctionInfo):
     """Record call edges, function references, jit call-form entries and
     traced-callable registrations found in ``info``'s body."""
@@ -693,6 +897,32 @@ def _collect_edges(ctx: LintContext, info: FunctionInfo):
         if isinstance(node, ast.Call):
             canon = mod.resolve(node.func)
             info.calls.update(_function_targets(ctx, info, node.func))
+            # thread spawns: Thread(target=...) constructors and
+            # .submit/.map worker-pool dispatches (jax combinators and
+            # jit wrappers excluded by canonical name)
+            if canon in THREAD_SPAWNERS:
+                tgt = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tgt = kw.value
+                if tgt is None and len(node.args) > 1:
+                    tgt = node.args[1]  # Thread(group, target, ...)
+                if tgt is not None:
+                    _mark_spawned(
+                        ctx, info, tgt,
+                        f"threading.Thread target in {info.full_name}",
+                    )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in THREAD_DISPATCH_METHODS
+                and canon not in TRACED_CALLABLE_ARGS
+                and not (canon or "").startswith("jax.")
+                and node.args
+            ):
+                _mark_spawned(
+                    ctx, info, node.args[0],
+                    f".{node.func.attr}() dispatch in {info.full_name}",
+                )
             # jax.jit(fn) call form -> fn is a compiled entry point
             if canon in JIT_WRAPPERS or canon in CUSTOM_DERIV:
                 for arg in node.args[:1]:
@@ -718,8 +948,21 @@ def _collect_edges(ctx: LintContext, info: FunctionInfo):
                         ctx.functions[t].traced_body = True
             # plain function-valued arguments (higher-order helpers that
             # trace their callable, e.g. _scan_with_convergence(step, ...))
+            has_fn_arg = False
             for arg in list(node.args) + [k.value for k in node.keywords]:
-                info.refs.update(_function_targets(ctx, info, arg))
+                targets = _function_targets(ctx, info, arg)
+                if targets or (
+                    isinstance(arg, ast.Call)
+                    and _spawn_targets(ctx, info, arg)
+                ) or _own_param_name(info, arg) is not None:
+                    # function-valued, partial-wrapped, or a bare
+                    # parameter forwarded onward (the dispatcher-chain
+                    # case the fixpoint below needs to see)
+                    has_fn_arg = True
+                info.refs.update(targets)
+            if has_fn_arg and not isinstance(info.node, ast.Module):
+                # kept for the dispatcher pass (_resolve_dispatchers)
+                ctx._call_records.append((info, node))
         elif isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
             getattr(node, "ctx", None), ast.Load
         ):

@@ -41,11 +41,15 @@ def _load_rule_modules():
         return
     # import order is alphabetical and irrelevant: rules are independent
     from tools.graftlint.rules import (  # noqa: F401
+        checkpoint_schema,
         dtype_discipline,
         frozen_path,
         hot_path,
+        lock_discipline,
         metrics_catalog,
+        resource_lifecycle,
         retrace_hazard,
+        shared_state,
     )
     _LOADED = True
 

@@ -1,0 +1,321 @@
+"""checkpoint-schema: save/load field symmetry of service checkpoints.
+
+The PR 10 bug class this machine-checks: a field written into the
+crash-safe service checkpoint (`OptimizationService._tenant_checkpoint`
+-> `storage.save_service_checkpoint_to_h5`) that the resume path
+(`load_service_checkpoint_from_h5` -> `resume`/`_apply_restore`) never
+consumes — or consumed without being written — silently breaks bitwise
+crash-resume. ``optimizer_draws`` was exactly such a field, caught only
+in PR 10 review; this rule turns the asymmetry red at lint time.
+
+Mechanics (pure AST, like every graftlint rule):
+
+- **writer fields** per section (``service`` / ``state`` / ``arrays``):
+  the string keys of dict literals assigned to the section name (or
+  appearing as the section's value in a payload literal) plus
+  ``section["key"] = ...`` subscript stores, inside the registered
+  writer functions.
+- **reader fields**: string keys read via ``d["key"]`` / ``d.get("key")``
+  / ``d.pop("key")`` where ``d`` derives from the section (directly, or
+  through a variable assigned from it), inside the registered readers.
+- cross-checks: writer == registry; registry minus ``write_only`` ⊆
+  readers; the storage-side ``_CHECKPOINT_ARRAYS`` tuple ==
+  registry arrays; ``SERVICE_CHECKPOINT_VERSION`` == ``SCHEMA_VERSION``.
+
+Bump procedure: ``python -m tools.graftlint --bump-schema`` rewrites
+the FIELDS registry from the CURRENT writer AST, preserving
+``write_only`` flags (docs/concurrency.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.graftlint.engine import Finding, FunctionInfo, LintContext
+from tools.graftlint.registry import Rule, register
+
+
+def default_registry() -> dict:
+    from tools.graftlint import checkpoint_registry as reg
+
+    return {
+        "version": reg.SCHEMA_VERSION,
+        "writers": reg.WRITERS,
+        "readers": reg.READERS,
+        "fields": reg.FIELDS,
+        "storage_arrays": reg.STORAGE_ARRAYS,
+        "storage_version": reg.STORAGE_VERSION,
+    }
+
+
+# ----------------------------------------------------- field extraction
+
+
+def writer_fields(info: FunctionInfo, section: str) -> Set[str]:
+    """String keys the writer function assembles for `section`: keys of
+    dict literals bound to the section name, keys of the dict-literal
+    VALUE under the section key in a payload literal, and constant
+    subscript stores ``section[...] = ...``."""
+    out: Set[str] = set()
+
+    def dict_keys(d: ast.Dict) -> Set[str]:
+        return {
+            k.value
+            for k in d.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+
+    for node in ast.walk(info.node):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            value = node.value
+            if value is None:
+                continue
+            named = any(
+                isinstance(t, ast.Name) and t.id == section for t in targets
+            )
+            if named and isinstance(value, ast.Dict):
+                out |= dict_keys(value)
+            # section["key"] = ...
+            for t in targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == section
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                ):
+                    out.add(t.slice.value)
+        if isinstance(node, ast.Dict):
+            # {"section": {...}} payload form
+            for k, v in zip(node.keys, node.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and k.value == section
+                    and isinstance(v, ast.Dict)
+                ):
+                    out |= dict_keys(v)
+    return out
+
+
+_SECTIONS = ("service", "state", "arrays")
+
+
+def _section_of(expr: ast.AST, section_vars: Dict[str, str]) -> Optional[str]:
+    """Which checkpoint section `expr` derives from: ``x["state"]``,
+    ``x.get("state", ...)``, or a variable previously assigned one."""
+    if isinstance(expr, ast.Name):
+        return section_vars.get(expr.id)
+    if isinstance(expr, ast.Subscript) and isinstance(
+        expr.slice, ast.Constant
+    ):
+        if expr.slice.value in _SECTIONS:
+            return expr.slice.value
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "get"
+        and expr.args
+        and isinstance(expr.args[0], ast.Constant)
+        and expr.args[0].value in _SECTIONS
+    ):
+        return expr.args[0].value
+    return None
+
+
+def reader_fields(info: FunctionInfo) -> Dict[str, Set[str]]:
+    """{section: keys consumed} in a reader function: constant
+    subscripts and ``.get``/``.pop`` calls whose receiver derives from a
+    checkpoint section (directly or via one level of local variable)."""
+    section_vars: Dict[str, str] = {}
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                sec = _section_of(node.value, section_vars)
+                if sec is not None:
+                    section_vars[t.id] = sec
+    out: Dict[str, Set[str]] = {s: set() for s in _SECTIONS}
+    for node in ast.walk(info.node):
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Load)
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+        ):
+            sec = _section_of(node.value, section_vars)
+            if sec is not None:
+                out[sec].add(node.slice.value)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "pop")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            sec = _section_of(node.func.value, section_vars)
+            if sec is not None:
+                out[sec].add(node.args[0].value)
+    return out
+
+
+def _module_constant(ctx: LintContext, dotted: str):
+    """(module, node, value) of a module-level constant assignment
+    ``NAME = <tuple/str/int literal>``, or None when absent."""
+    modname, _, name = dotted.rpartition(".")
+    mod = ctx.modules_by_name.get(modname)
+    if mod is None:
+        return None
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    try:
+                        return mod, stmt, ast.literal_eval(stmt.value)
+                    except (ValueError, SyntaxError):
+                        return mod, stmt, None
+    return None
+
+
+@register
+class CheckpointSchemaRule(Rule):
+    name = "checkpoint-schema"
+    description = (
+        "service-checkpoint fields written on the save path must be "
+        "consumed on the resume path (and vice versa) and match the "
+        "frozen schema registry (--bump-schema to change)"
+    )
+    incident = (
+        "the PR 10 optimizer_draws near-miss: a checkpoint field "
+        "written but not replayed on resume silently breaks bitwise "
+        "crash-recovery; only review caught it"
+    )
+
+    def registry(self, ctx: LintContext) -> dict:
+        override = ctx.options.get("checkpoint_registry")
+        if override is not None:
+            return override
+        return default_registry()
+
+    def check(self, ctx: LintContext):
+        findings: List[Finding] = []
+        reg = self.registry(ctx)
+        fields: Dict[str, Dict[str, dict]] = reg["fields"]
+
+        # resolve writer/reader functions; a fixture run that does not
+        # include the service module skips silently (the full
+        # `make lint` target set covers it)
+        writers: Dict[str, List[FunctionInfo]] = {}
+        any_resolved = False
+        for section, names in reg["writers"].items():
+            infos = [
+                ctx.functions[n] for n in names if n in ctx.functions
+            ]
+            writers[section] = infos
+            any_resolved = any_resolved or bool(infos)
+        readers = [
+            ctx.functions[n] for n in reg["readers"] if n in ctx.functions
+        ]
+        if not any_resolved:
+            return findings
+
+        # ---- writer side vs registry
+        for section, infos in writers.items():
+            if not infos:
+                continue
+            written: Set[str] = set()
+            for info in infos:
+                written |= writer_fields(info, section)
+            registered = set(fields.get(section, {}))
+            anchor = infos[0]
+            for extra in sorted(written - registered):
+                ctx.emit(
+                    findings, self.name, anchor.module, anchor.node,
+                    f"checkpoint field '{section}.{extra}' is written by "
+                    f"{anchor.qualname} but absent from the schema "
+                    f"registry — run `python -m tools.graftlint "
+                    f"--bump-schema` and make the resume path consume "
+                    f"it (or mark it write_only with a reason)",
+                    qualname=anchor.full_name,
+                )
+            for missing in sorted(registered - written):
+                ctx.emit(
+                    findings, self.name, anchor.module, anchor.node,
+                    f"registered checkpoint field '{section}.{missing}' "
+                    f"is no longer written by {anchor.qualname} — "
+                    f"restore the write or bump the schema registry "
+                    f"(old checkpoints carrying it will no longer "
+                    f"round-trip)",
+                    qualname=anchor.full_name,
+                )
+
+        # ---- reader side: every non-write_only field is consumed
+        if readers:
+            consumed: Dict[str, Set[str]] = {s: set() for s in _SECTIONS}
+            for info in readers:
+                for sec, keys in reader_fields(info).items():
+                    consumed[sec] |= keys
+            anchor = readers[0]
+            for section, fset in fields.items():
+                for fname, meta in sorted(fset.items()):
+                    if meta.get("write_only"):
+                        continue
+                    if fname not in consumed.get(section, set()):
+                        ctx.emit(
+                            findings, self.name, anchor.module,
+                            anchor.node,
+                            f"checkpoint field '{section}.{fname}' is "
+                            f"written on the save path but never "
+                            f"consumed on the resume path "
+                            f"({', '.join(i.qualname for i in readers)})"
+                            f" — the optimizer_draws bug class: resume "
+                            f"silently diverges from the checkpointed "
+                            f"run; read the field back or mark it "
+                            f"write_only with a reason",
+                            qualname=anchor.full_name,
+                        )
+            # fields consumed but not registered (reader reads a field
+            # the writer no longer produces)
+            for section in _SECTIONS:
+                for fname in sorted(
+                    consumed.get(section, set()) - set(fields.get(section, {}))
+                ):
+                    ctx.emit(
+                        findings, self.name, anchor.module, anchor.node,
+                        f"resume path consumes checkpoint field "
+                        f"'{section}.{fname}' that no writer produces "
+                        f"and the schema registry does not know — a "
+                        f"resumed run would read a hole; write the "
+                        f"field or drop the read",
+                        qualname=anchor.full_name,
+                    )
+
+        # ---- storage-side array allowlist and version constant
+        arrays_const = _module_constant(ctx, reg["storage_arrays"])
+        if arrays_const is not None:
+            mod, node, value = arrays_const
+            want = set(fields.get("arrays", {}))
+            got = set(value or ())
+            if got != want:
+                ctx.emit(
+                    findings, self.name, mod, node,
+                    f"storage _CHECKPOINT_ARRAYS {sorted(got)} does not "
+                    f"match the schema registry's arrays "
+                    f"{sorted(want)} — an array the service writes but "
+                    f"storage drops is silent data loss on resume",
+                )
+        version_const = _module_constant(ctx, reg["storage_version"])
+        if version_const is not None:
+            mod, node, value = version_const
+            if value != reg["version"]:
+                ctx.emit(
+                    findings, self.name, mod, node,
+                    f"SERVICE_CHECKPOINT_VERSION ({value}) != schema "
+                    f"registry SCHEMA_VERSION ({reg['version']}) — bump "
+                    f"them together (--bump-schema syncs the registry)",
+                )
+        return findings

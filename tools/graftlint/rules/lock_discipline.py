@@ -1,0 +1,239 @@
+"""lock-discipline: ordering cycles, raw acquire(), blocking under lock.
+
+Three lock pathologies the threaded service must never ship:
+
+- **ordering cycles**: the package-wide lock-ordering graph (edges from
+  every lock held to every lock acquired under it, lexically and
+  through calls) must stay acyclic — an A->B order in one thread and
+  B->A in another is a deadlock waiting for load. The evaluator's
+  documented hierarchy (handle ``_lock`` -> evaluator ``_acct_lock`` ->
+  nothing) is what this rule machine-checks.
+- **raw acquire()**: ``lock.acquire()`` outside a ``with`` (and without
+  a ``try/finally: lock.release()``) leaks the lock on any exception
+  between acquire and release.
+- **blocking while holding a lock**: ``time.sleep``, ``.join()``/
+  ``.result()``/``.wait()``, ``Queue.get``, file IO (``open``,
+  ``h5py.File``) and ``subprocess`` calls made while a lock is held
+  (lexically or via the caller-holds-lock entry condition) stall every
+  other thread contending for that lock — the writer-thread stall
+  class.
+
+Same-lock nesting (``with self._lock`` inside itself, for a
+non-reentrant Lock) is reported as an immediate self-deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.graftlint.concurrency import get_model
+from tools.graftlint.engine import Finding, LintContext
+from tools.graftlint.registry import Rule, register
+
+
+def _locks_acquired_transitively(model) -> Dict[str, Set[str]]:
+    """fullname -> every lock id the function may acquire, directly or
+    through its (analyzed) callees. Fixpoint over the call graph."""
+    direct: Dict[str, Set[str]] = {}
+    for fname, conc in model.fn_conc.items():
+        s = {lid for lid, _ in conc.regions}
+        s.update(lid for lid, _, _, _ in conc.acquires if lid)
+        direct[fname] = s
+    acquired = {f: set(s) for f, s in direct.items()}
+    for _ in range(len(acquired) + 2):
+        changed = False
+        for fname, conc in model.fn_conc.items():
+            s = acquired[fname]
+            before = len(s)
+            for cs in conc.calls:
+                for t in cs.targets:
+                    s |= acquired.get(t, set())
+            if len(s) != before:
+                changed = True
+        if not changed:
+            break
+    return acquired
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "no lock-ordering cycles, no raw acquire() without "
+        "with/try-finally, no blocking calls (sleep, join, result, "
+        "Queue.get, file IO, subprocess) while holding a lock"
+    )
+    incident = (
+        "the PR 8-10 service built a multi-lock hierarchy (service, "
+        "handle, evaluator accounting, telemetry); one inverted "
+        "acquisition or one h5py write under a lock deadlocks or "
+        "stalls every stepping thread"
+    )
+
+    def check(self, ctx: LintContext):
+        findings: List[Finding] = []
+        model = get_model(ctx)
+        acquired = _locks_acquired_transitively(model)
+
+        # ---- build the lock-ordering graph with provenance
+        edges: Dict[Tuple[str, str], Tuple] = {}  # (a, b) -> (mod, node, fn)
+        for fname, conc in model.fn_conc.items():
+            info = ctx.functions[fname]
+            entry = model.entry_locks.get(fname, frozenset())
+            for a, b, node in conc.order_edges:
+                edges.setdefault((a, b), (info.module, node, fname))
+            # entry-held locks order before locks acquired in the body
+            for lid, node in conc.regions:
+                for h in entry:
+                    if h != lid:
+                        edges.setdefault((h, lid), (info.module, node, fname))
+            # locks held at a call site order before everything the
+            # callee may acquire
+            for cs in conc.calls:
+                held = frozenset(cs.held) | entry
+                if not held:
+                    continue
+                for t in cs.targets:
+                    for lid in acquired.get(t, ()):
+                        for h in held:
+                            if h != lid:
+                                edges.setdefault(
+                                    (h, lid), (info.module, cs.node, fname)
+                                )
+                            elif not model.is_reentrant(lid):
+                                ctx.emit(
+                                    findings, self.name, info.module,
+                                    cs.node,
+                                    f"call while holding '{lid}' reaches "
+                                    f"'{t}', which acquires the same "
+                                    f"non-reentrant lock — self-deadlock "
+                                    f"if both run on one instance",
+                                    qualname=fname,
+                                )
+
+        # ---- same-lock lexical nesting
+        for fname, conc in model.fn_conc.items():
+            info = ctx.functions[fname]
+            for lid, node in conc.same_lock_nesting:
+                ctx.emit(
+                    findings, self.name, info.module, node,
+                    f"nested `with` on the same non-reentrant lock "
+                    f"'{lid}' — deadlocks immediately; use RLock or "
+                    f"restructure",
+                    qualname=fname,
+                )
+            entry = model.entry_locks.get(fname, frozenset())
+            for lid, node in conc.regions:
+                if lid in entry and not model.is_reentrant(lid):
+                    ctx.emit(
+                        findings, self.name, info.module, node,
+                        f"`with` on '{lid}' in a function whose every "
+                        f"call site already holds it — re-acquiring a "
+                        f"non-reentrant lock deadlocks",
+                        qualname=fname,
+                    )
+
+        # ---- ordering cycles: SCCs of the lock digraph
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for scc in _sccs(graph):
+            if len(scc) < 2:
+                continue
+            cyc = sorted(scc)
+            # anchor the finding at one witnessed edge inside the cycle
+            for (a, b), (mod, node, fname) in sorted(
+                edges.items(), key=lambda kv: kv[0]
+            ):
+                if a in scc and b in scc:
+                    ctx.emit(
+                        findings, self.name, mod, node,
+                        f"lock-ordering cycle {cyc}: '{a}' is acquired "
+                        f"before '{b}' here, but the reverse order also "
+                        f"exists — impose one global order or merge the "
+                        f"locks",
+                        qualname=fname,
+                    )
+                    break
+
+        # ---- raw acquire() without with/try-finally release
+        for fname, conc in model.fn_conc.items():
+            info = ctx.functions[fname]
+            for lid, node, protected, _held in conc.acquires:
+                if protected or lid in conc.finally_releases:
+                    continue
+                ctx.emit(
+                    findings, self.name, info.module, node,
+                    f"manual '{lid}.acquire()' without `with` or a "
+                    f"try/finally release — any exception before the "
+                    f"release leaks the lock; use `with {lid.split('.')[-1]}:`",
+                    qualname=fname,
+                )
+
+        # ---- blocking calls while holding a lock
+        for fname, conc in model.fn_conc.items():
+            info = ctx.functions[fname]
+            for desc, node, held in conc.blocking:
+                eff = model.held_at(info, held)
+                if not eff:
+                    continue
+                ctx.emit(
+                    findings, self.name, info.module, node,
+                    f"blocking call {desc} while holding "
+                    f"{sorted(eff)} — every thread contending for the "
+                    f"lock stalls behind it; move the blocking work "
+                    f"outside the lock",
+                    qualname=fname,
+                )
+        return findings
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan's strongly connected components, iterative."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    for start in graph:
+        if start in index:
+            continue
+        work: List[Tuple[str, iter]] = [(start, iter(graph[start]))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(graph[nxt])))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.add(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out

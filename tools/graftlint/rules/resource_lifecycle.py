@@ -1,0 +1,349 @@
+"""resource-lifecycle: every Thread/executor dies on a teardown path.
+
+The PR 2/10 discipline, machine-checked: a ``threading.Thread`` or
+``ThreadPoolExecutor`` stored on an instance must be joined / shut down
+by a method reachable from its owner's teardown entry (``close`` /
+``shutdown`` / ``stop`` / ``__exit__`` / ``__del__``) — the
+BackgroundWriter joins its worker in ``close()``, the HostFunEvaluator
+drains its pool through the bounded-join helper thread. A thread that
+outlives ``close()`` races HDF5 teardown (the exact crash
+``shutdown(wait=False)`` used to cause) and leaks into the next
+tenant's wall clock (``bench.py`` now reports ``active_thread_count``
+so the leak is visible in BENCH artifacts).
+
+Tiers:
+
+- **instance-attribute resources** (``self.X = Thread/Executor(...)``):
+  some teardown-reachable method of the owner must call
+  ``self.X.join(...)`` / ``.shutdown(...)`` / ``.close(...)`` (aliases
+  through locals — the ``pool, self._pool = self._pool, None`` swap —
+  are followed, nested closures included).
+- **resource-owning classes**: a class in the analyzed set that owns
+  thread resources and defines ``close`` becomes a resource type; an
+  attribute holding one (the service's ``_writer = BackgroundWriter()``)
+  must reach ``.close()`` the same way.
+- **function-local resources**: a local non-daemon Thread must be
+  ``.join``-ed in the same function; a local executor must be shut down
+  or used as a context manager. ``daemon=True`` fire-and-forget helpers
+  (deadline watchers, dedicated retry threads) are exempt — they cannot
+  block process exit, which is their documented contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import Finding, FunctionInfo, LintContext
+from tools.graftlint.registry import Rule, register
+
+THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+EXECUTOR_CTORS = {
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+}
+TEARDOWN_NAMES = {"close", "shutdown", "stop", "terminate", "__exit__",
+                  "__del__", "teardown", "join"}
+#: method-name substrings that also count as teardown entries (the
+#: driver's `_close_writer`-style helpers)
+TEARDOWN_NAME_PARTS = ("close", "shutdown", "teardown")
+TEARDOWN_CALLS = {"join", "shutdown", "close", "terminate", "stop"}
+
+KIND_LABEL = {
+    "thread": "thread", "executor": "executor",
+    "resource": "thread-owning",
+}
+
+
+def _teardown_entry_names(ctx, cls: str) -> List[str]:
+    """Teardown entry methods of `cls`: the exact names plus any method
+    whose name contains close/shutdown/teardown."""
+    out = []
+    prefix = f"{cls}."
+    for fullname in ctx.functions:
+        if not fullname.startswith(prefix):
+            continue
+        tail = fullname[len(prefix):]
+        if "." in tail:
+            continue  # nested def, not a method
+        if tail in TEARDOWN_NAMES or any(
+            p in tail.lower() for p in TEARDOWN_NAME_PARTS
+        ):
+            out.append(fullname)
+    return out
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _self_attr_target(t: ast.AST) -> Optional[str]:
+    if (
+        isinstance(t, ast.Attribute)
+        and isinstance(t.value, ast.Name)
+        and t.value.id in ("self", "cls")
+    ):
+        return t.attr
+    return None
+
+
+@register
+class ResourceLifecycleRule(Rule):
+    name = "resource-lifecycle"
+    description = (
+        "threads/executors stored on an instance are joined or shut "
+        "down on a teardown path reachable from the owner's close(); "
+        "local non-daemon threads are joined in-function"
+    )
+    incident = (
+        "the PR 2 shutdown(wait=False) crash: in-flight objective "
+        "threads raced HDF5 teardown; PR 10 re-established the "
+        "drain-don't-abandon close discipline this rule freezes"
+    )
+
+    def check(self, ctx: LintContext):
+        findings: List[Finding] = []
+
+        # ---- pass 1: classify constructors per class attribute and
+        # find function-local constructions
+        # {class_full: {attr: (kind, info, node)}}
+        attr_resources: Dict[str, Dict[str, Tuple[str, FunctionInfo, ast.AST]]] = {}
+        local_findings: List[Tuple[FunctionInfo, ast.AST, str]] = []
+        resource_classes: Set[str] = set()
+
+        def ctor_kind(mod, call: ast.Call) -> Optional[str]:
+            raw = mod.resolve(call.func)
+            if raw is None:
+                return None
+            candidates = [raw]
+            if "." not in raw:
+                # bare same-module class reference
+                candidates.append(f"{mod.modname}.{raw}")
+            for c in list(candidates):
+                chased = ctx.resolve_symbol(c, ctx.classes)
+                if chased:
+                    candidates.append(chased)
+            for canon in candidates:
+                if canon in THREAD_CTORS:
+                    return "thread"
+                if canon in EXECUTOR_CTORS:
+                    return "executor"
+                if canon in resource_classes:
+                    return "resource"
+            return None
+
+        def _ctor_calls(value: ast.AST):
+            """Every resource-constructor Call in an assignment value,
+            conditional expressions (`... if cond else None`) included."""
+            return [
+                sub for sub in ast.walk(value) if isinstance(sub, ast.Call)
+            ]
+
+        def scan_attr_resources():
+            for info in ctx.functions.values():
+                mod = info.module
+                if isinstance(info.node, ast.Lambda) or not info.class_name:
+                    continue
+                for node in ast.walk(info.node):
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif (
+                        isinstance(node, ast.AnnAssign)
+                        and node.value is not None
+                    ):
+                        targets, value = [node.target], node.value
+                    else:
+                        continue
+                    kind = None
+                    for call in _ctor_calls(value):
+                        kind = ctor_kind(mod, call)
+                        if kind is not None:
+                            break
+                    if kind is None:
+                        continue
+                    for t in targets:
+                        attr = _self_attr_target(t)
+                        if attr is not None:
+                            cls = f"{mod.modname}.{info.class_name}"
+                            attr_resources.setdefault(cls, {})[attr] = (
+                                kind, info, node
+                            )
+
+        scan_attr_resources()
+
+        # resource classes: analyzed classes that own thread/executor
+        # attrs AND define a teardown entry; rescan so attributes
+        # holding instances of them (service._writer) are tracked too
+        for cls, attrs in list(attr_resources.items()):
+            if any(k in ("thread", "executor") for k, _, _ in attrs.values()):
+                if _teardown_entry_names(ctx, cls):
+                    resource_classes.add(cls)
+        if resource_classes:
+            scan_attr_resources()
+
+        # ---- attribute-tier verification
+        for cls, attrs in sorted(attr_resources.items()):
+            teardown_fns = self._teardown_reachable(ctx, cls)
+            for attr, (kind, info, node) in sorted(attrs.items()):
+                label = KIND_LABEL.get(kind, kind)
+                if not teardown_fns:
+                    ctx.emit(
+                        findings, self.name, info.module, node,
+                        f"{label} resource 'self.{attr}' of {cls} has "
+                        f"no teardown path: the class defines no "
+                        f"close/shutdown/teardown method — a leaked "
+                        f"thread outlives the owner (the PR 2 "
+                        f"HDF5-race class)",
+                        qualname=info.full_name,
+                    )
+                    continue
+                if not self._torn_down(teardown_fns, attr):
+                    ctx.emit(
+                        findings, self.name, info.module, node,
+                        f"{label} resource 'self.{attr}' of {cls} is "
+                        f"never joined/shut down on a teardown path "
+                        f"reachable from the owner's close() — add the "
+                        f"join/shutdown/close to the teardown chain",
+                        qualname=info.full_name,
+                    )
+
+        # ---- local-tier verification
+        for info in ctx.functions.values():
+            mod = info.module
+            if isinstance(info.node, ast.Lambda):
+                continue
+            with_ctors: Set[int] = set()
+            for node in ast.walk(info.node):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        if isinstance(item.context_expr, ast.Call):
+                            with_ctors.add(id(item.context_expr))
+            assigned: Dict[int, str] = {}  # id(ctor call) -> local name
+            self_assigned: set = set()  # id(ctor call) under a self.X =
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                calls = [
+                    s for s in ast.walk(value) if isinstance(s, ast.Call)
+                ]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        for c in calls:
+                            assigned[id(c)] = t.id
+                    elif _self_attr_target(t) is not None:
+                        self_assigned.update(id(c) for c in calls)
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = ctor_kind(mod, node)
+                if kind not in ("thread", "executor"):
+                    continue
+                if id(node) in with_ctors:
+                    continue  # context-managed
+                if id(node) in self_assigned:
+                    continue  # handled by the attribute tier
+                if kind == "thread" and _is_daemon(node):
+                    continue  # fire-and-forget by contract
+                name = assigned.get(id(node))
+                verbs = "join" if kind == "thread" else "shutdown"
+                if name is None:
+                    # Thread(...).start() chains: nothing to join later
+                    ctx.emit(
+                        findings, self.name, mod, node,
+                        f"anonymous non-daemon {kind} constructed and "
+                        f"never {verbs}-ed — either keep a handle and "
+                        f"{verbs} it, or make it daemon=True if "
+                        f"fire-and-forget is intended",
+                        qualname=info.full_name,
+                    )
+                    continue
+                if not self._name_torn_down(info, name):
+                    ctx.emit(
+                        findings, self.name, mod, node,
+                        f"local {kind} '{name}' is never {verbs}-ed in "
+                        f"'{info.qualname}' — it outlives the function "
+                        f"(daemon=True or a with-block are the "
+                        f"fire-and-forget escapes)",
+                        qualname=info.full_name,
+                    )
+        return findings
+
+    # ------------------------------------------------------------ helpers
+
+    def _teardown_reachable(
+        self, ctx: LintContext, cls: str
+    ) -> List[FunctionInfo]:
+        """Functions reachable (via call edges) from the class's
+        teardown entries, the entries themselves included."""
+        entries = [
+            ctx.functions[n] for n in _teardown_entry_names(ctx, cls)
+        ]
+        seen: Dict[str, FunctionInfo] = {}
+        work = list(entries)
+        while work:
+            f = work.pop()
+            if f.full_name in seen:
+                continue
+            seen[f.full_name] = f
+            for name in f.calls:
+                g = ctx.functions.get(name)
+                if g is not None:
+                    work.append(g)
+        return list(seen.values())
+
+    def _torn_down(self, fns: List[FunctionInfo], attr: str) -> bool:
+        """Does any teardown-reachable function call a teardown verb on
+        ``self.<attr>`` or on a local aliasing it (tuple-swap aware)?
+        Nested closures (the bounded-drain lambda) are included — the
+        raw AST of each function is walked."""
+        for info in fns:
+            aliases: Set[str] = set()
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign):
+                    # name = self.attr   |   name, self.attr = self.attr, X
+                    pairs: List[Tuple[ast.AST, ast.AST]] = []
+                    for t in node.targets:
+                        if isinstance(t, ast.Tuple) and isinstance(
+                            node.value, ast.Tuple
+                        ) and len(t.elts) == len(node.value.elts):
+                            pairs.extend(zip(t.elts, node.value.elts))
+                        else:
+                            pairs.append((t, node.value))
+                    for tgt, val in pairs:
+                        if (
+                            isinstance(tgt, ast.Name)
+                            and _self_attr_target(val) == attr
+                        ):
+                            aliases.add(tgt.id)
+            for node in ast.walk(info.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in TEARDOWN_CALLS
+                ):
+                    continue
+                recv = node.func.value
+                if _self_attr_target(recv) == attr:
+                    return True
+                if isinstance(recv, ast.Name) and recv.id in aliases:
+                    return True
+        return False
+
+    def _name_torn_down(self, info: FunctionInfo, name: str) -> bool:
+        for node in ast.walk(info.node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in TEARDOWN_CALLS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name
+            ):
+                return True
+        return False

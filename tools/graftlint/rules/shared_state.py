@@ -1,0 +1,113 @@
+"""shared-state-guard: cross-thread mutable state is lock-guarded.
+
+The PR 8-10 incident class the service era produced: a mutable
+instance attribute (or module global) written from one thread root and
+touched from another — the BackgroundWriter's error slot, an eval
+handle's request table, a telemetry counter — silently races unless
+every access runs inside a ``with <lock>`` block on a lock owned by the
+same object.
+
+The rule consumes the engine's thread-root resolver and the shared
+concurrency model: an attribute is *shared* when its (non-``__init__``)
+accesses span at least two execution contexts (two different thread
+roots, or a thread root and the main path) and at least one of them is
+a write. Every access to a shared attribute must then hold a lock —
+lexically (``with self._lock:``) or via the computed caller-holds-lock
+entry condition (a helper whose EVERY call site runs under the lock is
+lock-held, the repo's documented "caller holds ``self._lock``" idiom)
+— and all accesses must agree on at least one common lock.
+
+Deliberate exceptions (GIL-atomic flags and monotonic counters with
+documented ordering, e.g. the writer's ``_error``/``_failed``
+hand-off) carry a justified ``# graftlint: disable=shared-state-guard``
+suppression. Intrinsically thread-safe containers (``queue.Queue``,
+``threading.Event``, executors) and the locks themselves are exempt.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from tools.graftlint.concurrency import INIT_METHODS, Access, get_model
+from tools.graftlint.engine import Finding, LintContext
+from tools.graftlint.registry import Rule, register
+
+
+def _is_init(access: Access) -> bool:
+    tail = access.fn.qualname.rsplit(".", 1)[-1]
+    return tail in INIT_METHODS
+
+
+@register
+class SharedStateGuardRule(Rule):
+    name = "shared-state-guard"
+    description = (
+        "mutable attributes/globals written in one thread context and "
+        "touched in another must be accessed under a common lock"
+    )
+    incident = (
+        "the PR 8-10 threaded-service era: unguarded shared state "
+        "across the writer thread, evaluator pools and deadline "
+        "helpers is a silent race a runtime detector only catches "
+        "after it corrupts an archive"
+    )
+
+    def check(self, ctx: LintContext):
+        findings: List[Finding] = []
+        model = get_model(ctx)
+
+        # group accesses by (owner, name) across the whole target set
+        grouped: Dict[Tuple[str, str], List[Access]] = {}
+        for conc in model.fn_conc.values():
+            for acc in conc.attr_accesses + conc.global_accesses:
+                grouped.setdefault((acc.owner, acc.name), []).append(acc)
+
+        for (owner, name), accesses in sorted(grouped.items()):
+            live = [a for a in accesses if not _is_init(a)]
+            writes = [a for a in live if a.write]
+            if not writes:
+                continue
+            ctx_sets = {model.contexts(a.fn) for a in live}
+            all_ctx = frozenset().union(*ctx_sets) if ctx_sets else frozenset()
+            if len(all_ctx) < 2:
+                continue  # single-context state needs no lock
+
+            held_sets = []
+            unguarded = []
+            for a in live:
+                held = model.held_at(a.fn, a.held)
+                if held:
+                    held_sets.append(held)
+                else:
+                    unguarded.append(a)
+            roots = sorted(c for c in all_ctx if c != "<main>")
+            where = ", ".join(
+                ["the main path"] if "<main>" in all_ctx else []
+            ) or ""
+            ctx_desc = " and ".join(
+                filter(None, [", ".join(roots), where])
+            )
+            for a in unguarded:
+                kind = "written" if a.write else "read"
+                ctx.emit(
+                    findings, self.name, a.fn.module, a.node,
+                    f"'{name}' (owner {owner}) is shared across thread "
+                    f"contexts ({ctx_desc}) but {kind} here without a "
+                    f"lock — wrap the access in `with <lock>:` on a "
+                    f"lock owned by {owner}, or justify-suppress a "
+                    f"deliberate GIL-atomic access",
+                    qualname=a.fn.full_name,
+                )
+            if not unguarded and held_sets:
+                common = frozenset.intersection(*held_sets)
+                if not common:
+                    a = writes[0]
+                    ctx.emit(
+                        findings, self.name, a.fn.module, a.node,
+                        f"'{name}' (owner {owner}) is guarded, but its "
+                        f"accesses hold DIFFERENT locks "
+                        f"({sorted(set().union(*held_sets))}) — "
+                        f"cross-thread exclusion needs one common lock",
+                        qualname=a.fn.full_name,
+                    )
+        return findings
