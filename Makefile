@@ -61,6 +61,15 @@ chaos:
 bench:
 	python bench.py
 
+# contention-immune regression gate: judge the newest BENCH_HISTORY.jsonl
+# row against the comparable rows before it — device-time regressions
+# (the ledger's per-program device seconds) fail hard on any host; wall
+# regressions on a contended or CPU-fallback run only read as suspect
+# (the mechanized BENCH_r04/r05 lesson; docs/observability.md
+# "Device-time ledger")
+bench-diff:
+	$(PY) tools/perfdiff.py --history BENCH_HISTORY.jsonl
+
 # the non-dominated-ranking microbench alone (points ranked/sec + peak
 # live bytes of the tiled sweep vs the dense matrix peel)
 bench-rank:

@@ -426,7 +426,18 @@ def status(status_file, as_json):
         f"queues: pending_submissions={qd.get('pending_submissions', 0)} "
         f"writer_backlog={qd.get('writer_backlog', 0)} "
         f"series_overflow_total={snap.get('series_overflow_total', 0)}"
+        + (
+            f" spans_dropped={snap['spans_dropped']}"
+            if snap.get("spans_dropped") is not None
+            else ""
+        )
     )
+    if snap.get("spans_dropped"):
+        click.echo(
+            "  note: the span buffer overflowed — the Chrome export "
+            "keeps only the most recent window (raise trace_max_spans "
+            "to keep more)"
+        )
     writer = snap.get("writer", {})
     if writer.get("failed") or writer.get("retries_total"):
         click.echo(
@@ -499,6 +510,38 @@ def status(status_file, as_json):
             if extras:
                 line += "  [" + " ".join(extras) + "]"
             click.echo(line)
+    dl = snap.get("device_ledger")
+    if dl:
+        # device truth (profiled steps): trace-derived fractions beat
+        # the host-clock throughput line above whenever they disagree
+        cap = dl.get("last_capture") or {}
+        click.echo(
+            f"device: busy_fraction={_fmt(dl.get('device_busy_fraction'), 0, 3)} "
+            f"overlap_ratio={_fmt(dl.get('device_overlap_ratio'), 0, 3)} "
+            f"captures={dl.get('captures', 0)} "
+            f"joined={cap.get('n_joined', '-')}/{cap.get('n_spans', '-')} spans"
+        )
+        for row in dl.get("programs", []):
+            line = (
+                f"  program {row.get('program', '?')}"
+                + (f" [{row['bucket']}]" if row.get("bucket") else "")
+                + f": device {_fmt(row.get('device_time_s'), 0, 3)}s"
+                f" / host {_fmt(row.get('host_time_s'), 0, 3)}s"
+                f" compile {_fmt(row.get('compile_s'), 0, 3)}s"
+                f" x{row.get('compiles', 0)}"
+            )
+            if row.get("memory_bytes"):
+                line += f" mem {int(row['memory_bytes'])}B"
+            if row.get("retraces"):
+                line += f" retraces={row['retraces']}"
+            click.echo(line)
+        tds = dl.get("tenant_device_seconds")
+        if tds:
+            parts = []
+            for tenant, phases_ in sorted(tds.items()):
+                total = sum(phases_.values())
+                parts.append(f"{tenant}={total:.3f}s")
+            click.echo("  tenant device seconds: " + " ".join(parts))
     if snap.get("trace_path"):
         click.echo(f"trace: {snap['trace_path']}")
 

@@ -58,7 +58,7 @@ from dmosopt_tpu.telemetry import (
     span_scope,
 )
 from dmosopt_tpu.utils.prng import as_generator
-from dmosopt_tpu.utils.profiling import device_trace, eval_time_stats
+from dmosopt_tpu.utils.profiling import eval_time_stats
 
 logger = logging.getLogger(__name__)
 
@@ -1415,8 +1415,13 @@ class DistOptimizer:
             tel.set_epoch(epoch)
             record_device_memory(tel)
             if tel.should_trace(epoch):
-                trace_ctx = device_trace(tel.profile_dir)
-                tel.event("trace", profile_dir=tel.profile_dir)
+                # capture + device-time ledger ingest: on exit the
+                # profiler trace is joined to this epoch's host spans
+                # and the trace-derived device_busy_fraction /
+                # device_overlap_ratio gauges are set (the host-clock
+                # pipeline_overlap_ratio gauge below stays as the cheap
+                # always-on estimate; the ledger is ground truth)
+                trace_ctx = tel.device_capture(epoch)
 
         with trace_ctx, span_scope(tel, "epoch", epoch=epoch):
             self.stats["init_sampling_start"] = time.time()

@@ -228,6 +228,34 @@ def offspring_per_generation(optimizer) -> int:
     )
 
 
+def _record_program_compile(
+    telemetry, program: str, compiled, compile_s: float, retrace: bool = False
+):
+    """One observable sequential-path compile into the device-time
+    ledger plus a `program_compile` event (the sequential analogue of
+    the batched core's `bucket_compile`). Eager, once per compiled
+    shape — never on the generation hot path."""
+    if not telemetry:
+        return
+    from dmosopt_tpu.telemetry.device_ledger import (
+        compiled_cost_estimates,
+        compiled_memory_bytes,
+    )
+
+    flops, nbytes = compiled_cost_estimates(compiled)
+    memory_bytes = compiled_memory_bytes(compiled)
+    if telemetry.ledger is not None:
+        telemetry.ledger.record_compile(
+            program, compile_s, flops=flops, bytes_accessed=nbytes,
+            memory_bytes=memory_bytes, retrace=retrace,
+        )
+    telemetry.event(
+        "program_compile", program=program, compile_s=round(compile_s, 4),
+        flops=flops, bytes_accessed=nbytes, memory_bytes=memory_bytes,
+        retrace=retrace,
+    )
+
+
 def _optimize_on_device(
     optimizer,
     eval_fn,
@@ -237,6 +265,7 @@ def _optimize_on_device(
     termination_check_interval: int = 10,
     logger=None,
     mesh=None,
+    telemetry=None,
 ):
     """Run the inner EA loop as scanned XLA programs.
 
@@ -302,8 +331,42 @@ def _optimize_on_device(
         return state, (x_gen, y_gen)
 
     @jax.jit
-    def run_chunk(state, keys):  # graftlint: disable=retrace-hazard -- built once per optimize() call, reused for every generation chunk; `step` closes over this call's optimizer/eval_fn by design
+    def run_chunk_jit(state, keys):  # graftlint: disable=retrace-hazard -- built once per optimize() call, reused for every generation chunk; `step` closes over this call's optimizer/eval_fn by design
         return jax.lax.scan(step, state, keys)
+
+    # Observable compiles (the device-time ledger's source a, extended
+    # from the batched core's bucket programs to this sequential path):
+    # with telemetry live and no mesh, each new argument shape goes
+    # through `lower().compile()` so the compile wall, the XLA
+    # cost-analysis FLOPs/bytes, and the executable's memory footprint
+    # are recorded under the `ea_scan` program row — numerically the
+    # program is identical to the implicit-jit dispatch (same lowering),
+    # so the bitwise trajectory pins hold. Mesh runs keep implicit jit
+    # (AOT executables would pin the input shardings).
+    explicit = bool(telemetry) and mesh is None
+    executables = {}
+
+    def run_chunk(state, keys):
+        if not explicit:
+            return run_chunk_jit(state, keys)
+        shape_key = tuple(
+            (
+                tuple(getattr(leaf, "shape", ())),
+                str(getattr(leaf, "dtype", type(leaf).__name__)),
+            )
+            for leaf in jax.tree_util.tree_leaves((state, keys))
+        )
+        compiled = executables.get(shape_key)
+        if compiled is None:
+            retrace = bool(executables)
+            t0 = time.perf_counter()
+            compiled = run_chunk_jit.lower(state, keys).compile()
+            compile_s = time.perf_counter() - t0
+            executables[shape_key] = compiled
+            _record_program_compile(
+                telemetry, "ea_scan", compiled, compile_s, retrace=retrace
+            )
+        return compiled(state, keys)
 
     adaptive = getattr(optimizer, "adaptive_population_size", False)
 
@@ -483,6 +546,7 @@ def optimize(
     logger=None,
     optimize_mean_variance: bool = False,
     mesh=None,
+    telemetry=None,
     **kwargs,
 ):
     """Inner multi-objective optimization against the (surrogate) model.
@@ -541,6 +605,7 @@ def optimize(
             termination_check_interval=termination_check_interval,
             logger=logger,
             mesh=mesh,
+            telemetry=telemetry,
         )
         x_new = [x_dev]
         y_new = [y_dev]
@@ -1043,6 +1108,7 @@ def epoch(
         initial=(x_0, y_0), popsize=pop, local_random=local_random,
         termination=termination, mesh=mesh, logger=logger,
         optimize_mean_variance=optimize_mean_variance,
+        telemetry=telemetry,
         **optimizer_kwargs_,
     )
 

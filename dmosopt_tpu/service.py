@@ -815,7 +815,19 @@ class OptimizationService:
         t0 = time.perf_counter()
         phases: Dict[str, float] = {}
         n_advanced = 0
-        with span_scope(self.telemetry, "epoch", step=self._steps_run):
+        # profiled steps (telemetry profile_dir/profile_epochs, keyed by
+        # the service step index): the whole step body runs under a
+        # jax.profiler capture that the device-time ledger ingests on
+        # exit — per-program device times joined to this step's
+        # gp_fit/ea_scan spans, per-tenant device seconds attributed
+        # through the tenant_cost span shares (docs/observability.md
+        # "Device-time ledger")
+        trace_ctx = (
+            self.telemetry.device_capture(self._steps_run)
+            if self.telemetry and self.telemetry.should_trace(self._steps_run)
+            else contextlib.nullcontext(None)
+        )
+        with trace_ctx, span_scope(self.telemetry, "epoch", step=self._steps_run):
             with self._step_phase(phases, "admit"), span_scope(
                 self.telemetry, "admit"
             ):
@@ -1288,6 +1300,16 @@ class OptimizationService:
         }
         if self.telemetry and self.telemetry.tracer is not None:
             snap["trace_path"] = self.telemetry.tracer.path
+            # span-buffer pressure: evictions past `trace_max_spans` —
+            # invisible outside this dict before the device-truth PR
+            snap["spans_dropped"] = self.telemetry.tracer.spans_dropped
+        ledger = self.telemetry.ledger if self.telemetry else None
+        if ledger is not None and ledger.has_data:
+            # device truth (profiled steps only): per-program device
+            # times, trace-derived busy/overlap fractions, per-tenant
+            # device seconds — the ground truth the host-clock
+            # throughput check above only estimates
+            snap["device_ledger"] = ledger.summary()
         return snap
 
     def _write_status(self):

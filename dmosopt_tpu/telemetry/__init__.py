@@ -8,8 +8,9 @@ the compile cache — so a run's observability has a single switchboard:
 - `Telemetry.log` (`EventLog`): typed per-epoch/per-phase records with a
   bounded ring buffer and an optional JSONL sink.
 - `jax.profiler` device traces for selected epochs
-  (``profile_dir`` / ``profile_epochs``, captured by the driver via
-  `dmosopt_tpu.utils.profiling.device_trace`).
+  (``profile_dir`` / ``profile_epochs``, captured via
+  `Telemetry.device_capture`, which also joins each capture's device
+  events into `Telemetry.ledger` — the device-time ledger).
 
 Configuration arrives through the driver's ``telemetry`` parameter
 (``dopt_params["telemetry"]``): ``True``/``None`` for the on-by-default
@@ -26,6 +27,7 @@ import contextlib
 import time
 from typing import Any, Dict, Optional, Sequence, Union
 
+from dmosopt_tpu.telemetry.device_ledger import DeviceLedger  # noqa: F401
 from dmosopt_tpu.telemetry.events import Event, EventLog, jsonable, read_jsonl  # noqa: F401
 from dmosopt_tpu.telemetry.registry import MetricsRegistry  # noqa: F401
 from dmosopt_tpu.telemetry.tracing import (  # noqa: F401
@@ -55,6 +57,8 @@ class Telemetry:
         enabled: bool = True,
         ring_size: int = 1024,
         jsonl_path: Optional[str] = None,
+        jsonl_max_bytes: Optional[int] = None,
+        jsonl_keep: int = 3,
         profile_dir: Optional[str] = None,
         profile_epochs: Optional[Sequence[int]] = None,
         histogram_buckets: Optional[Dict[str, Sequence[float]]] = None,
@@ -70,6 +74,21 @@ class Telemetry:
         self.log = EventLog(
             ring_size=ring_size,
             jsonl_path=jsonl_path if self.enabled else None,
+            max_bytes=jsonl_max_bytes,
+            keep=jsonl_keep,
+        )
+        if self.enabled:
+            # size-bounded sink rotation accounting (docs/observability.md)
+            self.log.on_rotate = lambda: self.registry.counter_inc(
+                "telemetry_sink_rotations_total"
+            )
+        # device-time ledger: per-compiled-program device truth, fed by
+        # observable compiles always and by jax.profiler captures when
+        # profiling is armed (`device_capture`). A disabled instance —
+        # and a telemetry=False run, which holds no Telemetry at all —
+        # has no ledger: zero hot-path calls stays pinned.
+        self.ledger: Optional[DeviceLedger] = (
+            DeviceLedger() if self.enabled else None
         )
         # spans are always collected on an enabled instance (they feed
         # per-epoch persistence and service introspection); `trace_path`
@@ -112,6 +131,71 @@ class Telemetry:
         if not self.enabled or self.profile_dir is None:
             return False
         return self.profile_epochs is None or int(epoch) in self.profile_epochs
+
+    @contextlib.contextmanager
+    def device_capture(self, epoch: Optional[int] = None):
+        """Capture a `jax.profiler` trace around the enclosed region and
+        fold it into the device-time ledger on exit: the capture's
+        device-event durations are joined to the host spans opened
+        inside the region (by `TraceAnnotation` name and order), the
+        trace-derived `device_busy_fraction` / `device_overlap_ratio`
+        gauges are set, and per-tenant device seconds land in
+        `tenant_device_seconds`. Replaces the bare
+        `utils.profiling.device_trace` at driver/service capture sites;
+        no-op (yields None) without a ``profile_dir`` or without jax."""
+        if not self.enabled or self.profile_dir is None:
+            yield None
+            return
+        try:
+            import jax
+        except Exception:
+            yield None
+            return
+        mark = self.tracer.mark() if self.tracer is not None else 0
+        t_start = time.time()
+        started = False
+        try:
+            jax.profiler.start_trace(self.profile_dir)
+            started = True
+            self.event("trace", epoch=epoch, profile_dir=self.profile_dir)
+        except Exception:
+            pass  # a profiler that refuses to start must not kill the epoch
+        try:
+            yield self.ledger
+        finally:
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    started = False
+            if started and self.ledger is not None:
+                spans = (
+                    self.tracer.spans_since(mark)
+                    if self.tracer is not None
+                    else []
+                )
+                cap = self.ledger.ingest_profile_dir(
+                    self.profile_dir, spans, newer_than=t_start
+                )
+                if cap is not None:
+                    if cap.device_busy_fraction is not None:
+                        self.gauge(
+                            "device_busy_fraction", cap.device_busy_fraction
+                        )
+                    if cap.device_overlap_ratio is not None:
+                        self.gauge(
+                            "device_overlap_ratio", cap.device_overlap_ratio
+                        )
+                    for (tenant, phase), sec in sorted(
+                        cap.tenant_device_seconds.items()
+                    ):
+                        self.inc(
+                            "tenant_device_seconds", sec,
+                            tenant=tenant, phase=phase,
+                        )
+                    self.event(
+                        "device_capture", epoch=epoch, **cap.to_dict()
+                    )
 
     # ------------------------------------------------------------ metrics
 

@@ -1,0 +1,533 @@
+"""Device-time ledger: per-compiled-program device truth.
+
+Every performance number the project steered by before this module was
+a host wall clock, and the project's own history shows host clocks lie:
+BENCH_r04/r05 walls were 3-9x inflated by host contention (and silently
+ran on CPU fallback), while ROADMAP items 2 and 6 gate on overlap and
+on "profile what's left" — both questions about *device-busy* time,
+which no `time.perf_counter()` difference can answer. The ledger is the
+ground-truth layer those decisions read:
+
+- **Compile-side accounting** (source a): every observably compiled
+  program — the batched core's bucket programs (`dmosopt_tpu.tenants`,
+  `fn.lower().compile()` since PR 9) and the sequential path's
+  generation-loop program (`moasmo._optimize_on_device`, made explicit
+  by this module's PR) — records compile wall seconds, XLA
+  cost-analysis FLOPs / bytes-accessed, and the executable's memory
+  footprint (argument + output + temp bytes: the HBM the program pins
+  while it runs) into per-program rows via `record_compile`.
+- **Trace-side accounting** (source b): when profiling is armed
+  (`profile_dir` / `profile_epochs`, the plumbing PR 1 added), the
+  owning driver/service wraps designated epochs in
+  `Telemetry.device_capture`, which runs `jax.profiler`
+  start/stop_trace and hands the captured Chrome trace to
+  `ingest_chrome_trace`. The parser splits the trace into **host
+  lanes** (the Python threads, where every `Tracer.span` also entered a
+  same-named `jax.profiler.TraceAnnotation`) and **device lanes**
+  (`/device:*` processes on TPU/GPU; the `tf_XLAEigen*` XLA threadpool
+  workers on the CPU backend), joins each host span to its annotation
+  occurrence BY NAME AND ORDER, and charges the device-lane busy time
+  inside each annotation window to that span's program row. From the
+  same pass it derives `device_busy_fraction` (device-busy union over
+  the capture window) and `device_overlap_ratio` (device-busy union
+  over the device timeline's extent — 1.0 means the device never
+  idled between programs, the ROADMAP item 2/6 success metric), and
+  attributes device seconds per tenant through the `tenant_cost` child
+  spans that tile each bucket span.
+
+The host-clock gauge (`pipeline_overlap_ratio`, driver.py) stays as the
+cheap always-on estimate; the ledger is the ground truth whenever
+profiling is armed. This module is deliberately **jax-free** (pure
+parsing and bookkeeping — the `jax.profiler` calls live in
+`Telemetry.device_capture`); the compiled-object helpers below only
+duck-type `cost_analysis()` / `memory_analysis()`.
+
+Nothing here runs on a hot path: `record_compile` fires once per
+compiled shape, trace ingestion only on explicitly profiled epochs, and
+a `telemetry=False` run holds no ledger at all (the zero-object pin).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: thread-name prefix of the XLA CPU backend's compute threadpool — the
+#: "device lanes" of a CPU capture (TPU/GPU captures have real
+#: `/device:*` processes instead)
+_CPU_DEVICE_THREAD_PREFIX = "tf_XLAEigen"
+#: zero-duration bookkeeping markers the CPU threadpool interleaves
+#: with its real op events — never busy time
+_MARKER_PREFIX = "ThreadpoolListener::"
+
+
+# ------------------------------------------------- compiled-object helpers
+
+
+def compiled_cost_estimates(compiled) -> Tuple[Optional[float], Optional[float]]:
+    """(flops, bytes accessed) from XLA's cost analysis of a compiled
+    executable; (None, None) where the backend does not report it."""
+    try:
+        analyses = compiled.cost_analysis()
+        if isinstance(analyses, dict):
+            analyses = [analyses]
+        flops = sum(float(a.get("flops", 0.0)) for a in analyses)
+        nbytes = sum(float(a.get("bytes accessed", 0.0)) for a in analyses)
+        return flops, nbytes
+    except Exception:
+        return None, None
+
+
+def compiled_memory_bytes(compiled) -> Optional[float]:
+    """The executable's device-memory footprint (argument + output +
+    temp bytes — what the program pins in HBM while it runs), or None
+    where the backend does not report a memory analysis."""
+    try:
+        ma = compiled.memory_analysis()
+        total = 0.0
+        for attr in (
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "temp_size_in_bytes",
+        ):
+            total += float(getattr(ma, attr, 0) or 0)
+        return total
+    except Exception:
+        return None
+
+
+# ----------------------------------------------------- interval utilities
+
+
+def _merge_intervals(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Sorted union of (start, end) intervals."""
+    out: List[Tuple[float, float]] = []
+    for s, e in sorted(intervals):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def _total(intervals: Sequence[Tuple[float, float]]) -> float:
+    return sum(e - s for s, e in intervals)
+
+
+def _clipped_total(
+    intervals: Sequence[Tuple[float, float]], lo: float, hi: float
+) -> float:
+    """Total length of `intervals` clipped to [lo, hi] (intervals must
+    already be a merged union, so the sum never double-counts)."""
+    out = 0.0
+    for s, e in intervals:
+        if e <= lo:
+            continue
+        if s >= hi:
+            break
+        out += min(e, hi) - max(s, lo)
+    return out
+
+
+# ------------------------------------------------------------ trace parse
+
+
+@dataclass
+class ParsedTrace:
+    """One capture's relevant content, in seconds relative to the
+    trace's own clock: per-name annotation windows (host lanes) and the
+    per-lane merged busy intervals of every device lane."""
+
+    annotations: Dict[str, List[Tuple[float, float]]]
+    device_lanes: Dict[Tuple[Any, Any], List[Tuple[float, float]]]
+    window: Tuple[float, float]  # extent of ALL trace events
+
+    @property
+    def device_busy(self) -> List[Tuple[float, float]]:
+        """Union of busy intervals across every device lane."""
+        merged: List[Tuple[float, float]] = []
+        for lane in self.device_lanes.values():
+            merged.extend(lane)
+        return _merge_intervals(merged)
+
+
+def parse_chrome_trace(trace: Dict[str, Any], span_names) -> ParsedTrace:
+    """Split a `jax.profiler` Chrome trace into annotation windows (host
+    events named exactly like one of `span_names` — the
+    `TraceAnnotation`s every `Tracer.span` enters) and device-lane busy
+    intervals (`/device:*` process events on accelerators, `tf_XLAEigen*`
+    worker-thread events on the CPU backend, bookkeeping markers
+    excluded)."""
+    names = set(span_names)
+    events = trace.get("traceEvents", []) or []
+    device_pids = set()
+    thread_names: Dict[Tuple[Any, Any], str] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "M":
+            continue
+        if ev.get("name") == "process_name":
+            pname = str((ev.get("args") or {}).get("name", ""))
+            if pname.startswith("/device:"):
+                device_pids.add(ev.get("pid"))
+        elif ev.get("name") == "thread_name":
+            thread_names[(ev.get("pid"), ev.get("tid"))] = str(
+                (ev.get("args") or {}).get("name", "")
+            )
+
+    annotations: Dict[str, List[Tuple[float, float]]] = {}
+    lanes: Dict[Tuple[Any, Any], List[Tuple[float, float]]] = {}
+    lo, hi = float("inf"), float("-inf")
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        ts = ev.get("ts")
+        dur = ev.get("dur", 0)
+        if not isinstance(ts, (int, float)) or not isinstance(dur, (int, float)):
+            continue
+        t0, t1 = ts / 1e6, (ts + dur) / 1e6
+        lo, hi = min(lo, t0), max(hi, t1)
+        key = (ev.get("pid"), ev.get("tid"))
+        name = ev.get("name", "")
+        on_device = ev.get("pid") in device_pids or thread_names.get(
+            key, ""
+        ).startswith(_CPU_DEVICE_THREAD_PREFIX)
+        if on_device:
+            if dur > 0 and not str(name).startswith(_MARKER_PREFIX):
+                lanes.setdefault(key, []).append((t0, t1))
+        elif name in names:
+            annotations.setdefault(name, []).append((t0, t1))
+
+    for key in lanes:
+        lanes[key] = _merge_intervals(lanes[key])
+    for name in annotations:
+        annotations[name].sort()
+    if lo > hi:
+        lo = hi = 0.0
+    return ParsedTrace(annotations=annotations, device_lanes=lanes, window=(lo, hi))
+
+
+def load_capture(profile_dir: str, newer_than: Optional[float] = None):
+    """The newest `jax.profiler` capture under `profile_dir`
+    (`plugins/profile/<timestamp>/*.trace.json.gz`), parsed to a trace
+    dict — or None when no capture (newer than `newer_than`, an
+    mtime-seconds bound) exists."""
+    paths = glob.glob(
+        os.path.join(profile_dir, "plugins", "profile", "*", "*.trace.json*")
+    )
+    try:
+        # a trace file can vanish between glob and stat (tmp cleaners,
+        # concurrent cleanup of a shared profile_dir) — an unreadable
+        # capture must never take the profiled epoch down
+        if newer_than is not None:
+            paths = [
+                p for p in paths if os.path.getmtime(p) >= newer_than - 1.0
+            ]
+        if not paths:
+            return None
+        path = max(paths, key=os.path.getmtime)
+    except OSError:
+        return None
+    opener = gzip.open if path.endswith(".gz") else open
+    try:
+        with opener(path, "rt") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError, EOFError):
+        return None
+
+
+# ----------------------------------------------------------------- ledger
+
+
+@dataclass
+class ProgramRow:
+    """Cumulative device-truth accounting for one program identity
+    (host-span/annotation name + bucket label)."""
+
+    program: str
+    bucket: Optional[str] = None
+    compiles: int = 0
+    retraces: int = 0
+    compile_s: float = 0.0
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    memory_bytes: Optional[float] = None
+    device_time_s: float = 0.0
+    host_time_s: float = 0.0
+    n_spans: int = 0  # host spans seen during captures
+    n_joined: int = 0  # host spans matched to a trace annotation
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "program": self.program,
+            "compiles": self.compiles,
+            "compile_s": round(self.compile_s, 6),
+            "device_time_s": round(self.device_time_s, 6),
+            "host_time_s": round(self.host_time_s, 6),
+            "n_spans": self.n_spans,
+            "n_joined": self.n_joined,
+        }
+        if self.bucket:
+            out["bucket"] = self.bucket
+        if self.retraces:
+            out["retraces"] = self.retraces
+        for k in ("flops", "bytes_accessed", "memory_bytes"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        if self.n_spans:
+            out["join_fraction"] = round(self.n_joined / self.n_spans, 4)
+        return out
+
+
+@dataclass
+class CaptureSummary:
+    """One ingested profiler capture, already reduced to the ledger's
+    vocabulary (seconds; fractions in [0, 1] where defined)."""
+
+    window_s: float
+    device_busy_s: float
+    device_busy_fraction: Optional[float]
+    device_overlap_ratio: Optional[float]
+    n_spans: int
+    n_joined: int
+    tenant_device_seconds: Dict[Tuple[str, str], float] = field(default_factory=dict)
+
+    @property
+    def join_fraction(self) -> Optional[float]:
+        return (self.n_joined / self.n_spans) if self.n_spans else None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "window_s": round(self.window_s, 6),
+            "device_busy_s": round(self.device_busy_s, 6),
+            "device_busy_fraction": (
+                round(self.device_busy_fraction, 4)
+                if self.device_busy_fraction is not None
+                else None
+            ),
+            "device_overlap_ratio": (
+                round(self.device_overlap_ratio, 4)
+                if self.device_overlap_ratio is not None
+                else None
+            ),
+            "n_spans": self.n_spans,
+            "n_joined": self.n_joined,
+            "join_fraction": (
+                round(self.join_fraction, 4)
+                if self.join_fraction is not None
+                else None
+            ),
+        }
+
+
+class DeviceLedger:
+    """Per-compiled-program device accounting: compile-side rows fed by
+    `record_compile`, trace-side device times folded in by
+    `ingest_chrome_trace`. Thread-safe (compiles can land from the
+    batched fit's worker threads)."""
+
+    def __init__(self):
+        self._rows: Dict[Tuple[str, Optional[str]], ProgramRow] = {}
+        self._tenant_device: Dict[Tuple[str, str], float] = {}
+        self.captures = 0
+        self.last_capture: Optional[CaptureSummary] = None
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- compiles
+
+    def record_compile(
+        self,
+        program: str,
+        compile_s: float,
+        *,
+        flops: Optional[float] = None,
+        bytes_accessed: Optional[float] = None,
+        memory_bytes: Optional[float] = None,
+        bucket: Optional[str] = None,
+        retrace: bool = False,
+    ) -> ProgramRow:
+        """Record one observable compile of `program` (the host-span /
+        annotation name its executions run under, e.g. ``ea_scan``)."""
+        with self._lock:
+            row = self._row_locked(program, bucket)
+            row.compiles += 1
+            row.compile_s += float(compile_s)
+            if retrace:
+                row.retraces += 1
+            # cost/memory describe the LATEST executable (a retrace may
+            # change shapes, and the newest program is the one running)
+            if flops is not None:
+                row.flops = float(flops)
+            if bytes_accessed is not None:
+                row.bytes_accessed = float(bytes_accessed)
+            if memory_bytes is not None:
+                row.memory_bytes = float(memory_bytes)
+            return row
+
+    def _row_locked(self, program: str, bucket: Optional[str]) -> ProgramRow:
+        key = (program, bucket)
+        row = self._rows.get(key)
+        if row is None:
+            row = self._rows[key] = ProgramRow(program=program, bucket=bucket)
+        return row
+
+    # ------------------------------------------------------------- traces
+
+    def ingest_chrome_trace(
+        self, trace: Dict[str, Any], host_spans
+    ) -> Optional[CaptureSummary]:
+        """Join one profiler capture against the host spans recorded
+        during it and fold device times into the program rows.
+
+        `host_spans`: the CLOSED `telemetry.tracing.Span`s opened while
+        the capture ran (the caller brackets the capture with
+        `Tracer.mark` / `spans_since`). Joining is per span name, in
+        time order — the k-th host span named N matches the k-th trace
+        annotation named N, because every `Tracer.span` entered exactly
+        one same-named `TraceAnnotation` in open order. Device time
+        charged to a span is the device-lane busy union clipped to its
+        annotation window; `tenant_cost` child spans split their
+        parent's device seconds by their host-share weights (the same
+        weights the host cost attribution uses)."""
+        spans = [s for s in host_spans if s.t_end is not None]
+        by_name: Dict[str, List] = {}
+        children: Dict[int, List] = {}
+        for s in spans:
+            if s.name == "tenant_cost":
+                if s.parent_id is not None:
+                    children.setdefault(s.parent_id, []).append(s)
+            else:
+                by_name.setdefault(s.name, []).append(s)
+        for lst in by_name.values():
+            lst.sort(key=lambda s: (s.t_start, s.span_id))
+
+        parsed = parse_chrome_trace(trace, by_name.keys())
+        busy = parsed.device_busy
+        window_s = max(parsed.window[1] - parsed.window[0], 0.0)
+        busy_s = _total(busy)
+        extent_s = (busy[-1][1] - busy[0][0]) if busy else 0.0
+
+        cap = CaptureSummary(
+            window_s=window_s,
+            device_busy_s=busy_s,
+            device_busy_fraction=(busy_s / window_s) if window_s > 0 else None,
+            device_overlap_ratio=(busy_s / extent_s) if extent_s > 0 else None,
+            n_spans=0,
+            n_joined=0,
+        )
+        with self._lock:
+            for name, name_spans in by_name.items():
+                windows = parsed.annotations.get(name, [])
+                # eviction alignment: the span buffer drops its OLDEST
+                # spans, so when the trace holds more annotation windows
+                # than surviving spans, the survivors correspond to the
+                # most RECENT windows — align to the tail, or the k-th
+                # survivor would silently join an earlier span's window
+                offset = max(len(windows) - len(name_spans), 0)
+                for i, sp in enumerate(name_spans):
+                    bucket = (sp.labels or {}).get("bucket")
+                    row = self._row_locked(name, bucket)
+                    row.n_spans += 1
+                    cap.n_spans += 1
+                    row.host_time_s += sp.duration_s or 0.0
+                    if i + offset >= len(windows):
+                        continue
+                    a0, a1 = windows[i + offset]
+                    dev_s = _clipped_total(busy, a0, a1)
+                    row.n_joined += 1
+                    cap.n_joined += 1
+                    row.device_time_s += dev_s
+                    # per-tenant attribution: the tenant_cost children
+                    # tile the parent span with the host attribution
+                    # weights; reuse those shares for device seconds
+                    kids = children.get(sp.span_id)
+                    host_dur = sp.duration_s or 0.0
+                    if kids and host_dur > 0 and dev_s > 0:
+                        for kid in kids:
+                            share = (kid.duration_s or 0.0) / host_dur
+                            tenant = str((kid.labels or {}).get("tenant", "?"))
+                            phase = str((kid.labels or {}).get("phase", "?"))
+                            key = (tenant, phase)
+                            amount = dev_s * share
+                            cap.tenant_device_seconds[key] = (
+                                cap.tenant_device_seconds.get(key, 0.0) + amount
+                            )
+                            self._tenant_device[key] = (
+                                self._tenant_device.get(key, 0.0) + amount
+                            )
+            self.captures += 1
+            self.last_capture = cap
+        return cap
+
+    def ingest_profile_dir(
+        self, profile_dir: str, host_spans, newer_than: Optional[float] = None
+    ) -> Optional[CaptureSummary]:
+        """Locate, load, and ingest the newest capture under
+        `profile_dir`. Returns None (no ledger mutation) when no capture
+        is found or it fails to parse — an unreadable trace must never
+        take the epoch down."""
+        trace = load_capture(profile_dir, newer_than=newer_than)
+        if trace is None:
+            return None
+        try:
+            return self.ingest_chrome_trace(trace, host_spans)
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def has_data(self) -> bool:
+        with self._lock:
+            return bool(self._rows) or self.captures > 0
+
+    @property
+    def device_busy_fraction(self) -> Optional[float]:
+        cap = self.last_capture
+        return cap.device_busy_fraction if cap is not None else None
+
+    @property
+    def device_overlap_ratio(self) -> Optional[float]:
+        cap = self.last_capture
+        return cap.device_overlap_ratio if cap is not None else None
+
+    def program_rows(self) -> List[ProgramRow]:
+        with self._lock:
+            return sorted(
+                self._rows.values(), key=lambda r: (r.program, r.bucket or "")
+            )
+
+    def tenant_device_seconds(self) -> Dict[str, Dict[str, float]]:
+        """{tenant: {phase: attributed device seconds}} (cumulative
+        across captures)."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for (tenant, phase), v in self._tenant_device.items():
+                out.setdefault(tenant, {})[phase] = round(v, 9)
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """JSON-able ledger snapshot: cumulative program rows, the last
+        capture's fractions, and per-tenant device seconds — what
+        `OptimizationService.introspect()` and the `status` CLI
+        surface."""
+        out: Dict[str, Any] = {
+            "captures": self.captures,
+            "programs": [r.to_dict() for r in self.program_rows()],
+        }
+        if self.last_capture is not None:
+            out["last_capture"] = self.last_capture.to_dict()
+            out["device_busy_fraction"] = self.last_capture.device_busy_fraction
+            out["device_overlap_ratio"] = self.last_capture.device_overlap_ratio
+        tenant = self.tenant_device_seconds()
+        if tenant:
+            out["tenant_device_seconds"] = tenant
+        return out

@@ -121,6 +121,7 @@ class Tracer:
         self._spans: List[Span] = []
         self._drained = 0  # index of the first span `drain` has not seen
         self._ids = itertools.count(1)
+        self._last_id = 0  # highest id handed out (for `mark`)
         self._lock = threading.Lock()
         self._tls = threading.local()
         # perf_counter origin paired with a wall-clock stamp so exported
@@ -151,6 +152,7 @@ class Tracer:
                     self._drained -= 1
                 self.spans_dropped += 1
             self._spans.append(sp)
+            self._last_id = max(self._last_id, sp.span_id)
             return True
 
     @contextlib.contextmanager
@@ -218,6 +220,22 @@ class Tracer:
             out = [s for s in out if s.name == name]
         return out
 
+    def mark(self) -> int:
+        """The highest span id handed out so far — bracket a region with
+        `mark()` / `spans_since(mark)` to collect exactly the spans it
+        opened (the device-ledger capture join uses this)."""
+        with self._lock:
+            return self._last_id
+
+    def spans_since(self, mark: int) -> List[Span]:
+        """Every buffered span opened after `mark`. Spans evicted by
+        the buffer bound are gone — the device ledger tail-aligns the
+        survivors to the most recent trace annotations, so eviction
+        loses the evicted spans' device time without misattributing
+        the survivors'."""
+        with self._lock:
+            return [s for s in self._spans if s.span_id > mark]
+
     def drain(self) -> List[Span]:
         """Closed spans not yet returned by a previous `drain` (the
         driver persists these per epoch). Spans stay in the export
@@ -256,13 +274,18 @@ class Tracer:
                     "args": {"name": f"host-{tid}"},
                 }
             )
+        # a bounded buffer may have evicted a span whose children remain:
+        # drop the dangling parent link (the child becomes a root in the
+        # exported window) so the export stays schema-valid under
+        # overflow — `spans_dropped` in otherData accounts for the loss
+        exported_ids = {sp.span_id for sp in spans}
         for sp in spans:
             t_end = sp.t_end if sp.t_end is not None else now
             args: Dict[str, Any] = {
                 "trace_id": sp.trace_id,
                 "span_id": sp.span_id,
             }
-            if sp.parent_id is not None:
+            if sp.parent_id is not None and sp.parent_id in exported_ids:
                 args["parent_id"] = sp.parent_id
             args.update({str(k): v for k, v in sp.labels.items()})
             events.append(

@@ -49,6 +49,10 @@ import jax
 import jax.numpy as jnp
 
 from dmosopt_tpu.telemetry import span_scope
+from dmosopt_tpu.telemetry.device_ledger import (
+    compiled_cost_estimates,
+    compiled_memory_bytes,
+)
 
 from dmosopt_tpu.config import resolve, default_optimizers
 from dmosopt_tpu.models import Model
@@ -417,20 +421,6 @@ def _bucket_program(sig: Tuple, optimizer, kernel: str, T: int) -> "_BucketProgr
     return prog
 
 
-def _cost_estimates(compiled) -> Tuple[Optional[float], Optional[float]]:
-    """(flops, bytes accessed) from XLA's cost analysis of a compiled
-    executable; (None, None) where the backend does not report it."""
-    try:
-        analyses = compiled.cost_analysis()
-        if isinstance(analyses, dict):
-            analyses = [analyses]
-        flops = sum(float(a.get("flops", 0.0)) for a in analyses)
-        nbytes = sum(float(a.get("bytes accessed", 0.0)) for a in analyses)
-        return flops, nbytes
-    except Exception:
-        return None, None
-
-
 def _run_bucket_program(
     prog: "_BucketProgram", sig: Tuple, T: int, args: Tuple,
     telemetry=None, logger=None, label: Optional[str] = None,
@@ -452,12 +442,22 @@ def _run_bucket_program(
     prog.executables[shape_key] = compiled
     sig_label = _sig_label(sig)
     if telemetry:
-        flops, nbytes = _cost_estimates(compiled)
+        flops, nbytes = compiled_cost_estimates(compiled)
+        memory_bytes = compiled_memory_bytes(compiled)
+        if telemetry.ledger is not None:
+            # device-time ledger row: the bucket program executes under
+            # the `ea_scan` span/annotation, so a later profiler capture
+            # joins its device events to this compile-side row
+            telemetry.ledger.record_compile(
+                "ea_scan", compile_s, flops=flops, bytes_accessed=nbytes,
+                memory_bytes=memory_bytes, bucket=label, retrace=retrace,
+            )
         telemetry.inc("tenant_bucket_compiles_total", bucket=label)
         telemetry.event(
             "bucket_compile", bucket=label, signature=sig_label,
             n_tenants=T, compile_s=round(compile_s, 4),
-            flops=flops, bytes_accessed=nbytes, retrace=retrace,
+            flops=flops, bytes_accessed=nbytes,
+            memory_bytes=memory_bytes, retrace=retrace,
         )
         if retrace:
             telemetry.inc("tenant_bucket_retraces_total", bucket=label)

@@ -5,18 +5,16 @@ Capability match: the reference keeps lightweight wall-clock bookkeeping
 (dmosopt.py:2361-2363), `*_start`/`*_end` phase keys diffed in
 `get_stats` (dmosopt.py:846-854), and eval-time aggregates
 (dmosopt.py:278-300). Those all survive unchanged in the driver; this
-module adds the TPU-side instruments the reference lacks (SURVEY §5.1):
-`jax.profiler` trace capture around a code region and a phase-timer
-context manager that feeds the same stats dict.
+module adds a phase-timer context manager that feeds the same stats
+dict. (Device trace capture moved to `Telemetry.device_capture`, which
+also joins each capture into the device-time ledger.)
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, Optional
-
-import jax
+from typing import Dict
 
 
 @contextlib.contextmanager
@@ -29,20 +27,6 @@ def phase_timer(stats: Dict, name: str):
         yield stats
     finally:
         stats[f"{name}_end"] = time.time()
-
-
-@contextlib.contextmanager
-def device_trace(log_dir: Optional[str] = None, host_only: bool = False):
-    """Capture a jax.profiler trace (viewable in TensorBoard / Perfetto)
-    around the enclosed region. No-op when `log_dir` is None."""
-    if log_dir is None:
-        yield
-        return
-    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 def eval_time_stats(times) -> Dict[str, float]:

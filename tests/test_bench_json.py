@@ -71,3 +71,23 @@ def test_import_bench_stays_jax_free():
         capture_output=True, text=True, timeout=120,
     )
     assert out.returncode == 0 and "OK" in out.stdout, out.stderr[-1000:]
+
+
+def test_append_history_skips_non_baseline_rows(tmp_path, monkeypatch):
+    """Smoke/partial/fault-injected rows AND failed-run error stubs
+    never enter BENCH_HISTORY.jsonl — an error stub measured nothing,
+    so a later `bench-diff` judging it would vacuously pass while the
+    junk row polluted the baseline pool."""
+    path = tmp_path / "hist.jsonl"
+    monkeypatch.setenv(bench._HISTORY_ENV, str(path))
+    good = {"value": 1.0, "configs": {}, "backend": "cpu"}
+    assert bench._append_history(dict(good)) == str(path)
+    for bad in (
+        {**good, "smoke": True},
+        {**good, "partial": True},
+        {**good, "fault_plan": "seed=1"},
+        {**good, "value": 0.0, "error": "bench child produced no result"},
+    ):
+        assert bench._append_history(bad) is None
+    rows = path.read_text().strip().splitlines()
+    assert len(rows) == 1

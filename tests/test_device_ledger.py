@@ -1,0 +1,370 @@
+"""Device-time ledger (ISSUE 12): per-compiled-program device truth.
+
+Pins the tentpole's three layers:
+
+- the jax-free trace parser and join (synthetic Chrome traces: host
+  annotation lanes, device lanes — `/device:*` processes and
+  `tf_XLAEigen*` CPU worker threads — marker exclusion, interval
+  unions, per-tenant attribution through `tenant_cost` span shares);
+- the compile-side rows: the sequential path's explicit
+  `lower().compile()` (a `program_compile` event + an `ea_scan` ledger
+  row with cost/memory analysis) and the batched core's bucket
+  programs feeding the same ledger;
+- the acceptance workload: a profiled 2-bucket multi-tenant service
+  run on the CPU backend whose ledger joins >= 90% of gp_fit/ea_scan
+  host spans by annotation name, with trace-derived
+  `device_busy_fraction` / `device_overlap_ratio` exposed through
+  `introspect()` and the `status` CLI.
+"""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+import dmosopt_tpu
+from dmosopt_tpu.benchmarks.zdt import zdt1
+from dmosopt_tpu.cli import status
+from dmosopt_tpu.driver import dopt_dict
+from dmosopt_tpu.service import OptimizationService
+from dmosopt_tpu.telemetry import Telemetry
+from dmosopt_tpu.telemetry.device_ledger import (
+    DeviceLedger,
+    _merge_intervals,
+    parse_chrome_trace,
+)
+from dmosopt_tpu.telemetry.tracing import Span
+
+SMK = {"n_starts": 2, "n_iter": 25, "seed": 0}
+
+
+# ------------------------------------------------------------ parser units
+
+
+def _meta(pid, pname=None, tid=None, tname=None):
+    if tname is None:
+        return {
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": pname},
+        }
+    return {
+        "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+        "args": {"name": tname},
+    }
+
+
+def _x(pid, tid, name, ts_us, dur_us):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name,
+            "ts": ts_us, "dur": dur_us}
+
+
+def _tpu_like_trace():
+    """Host process with a python thread carrying annotations; one
+    /device: process with two op lanes."""
+    return {
+        "traceEvents": [
+            _meta(1, pname="/host:CPU"),
+            _meta(1, tid=10, tname="python"),
+            _meta(7, pname="/device:TPU:0"),
+            _meta(7, tid=1, tname="lane-0"),
+            _meta(7, tid=2, tname="lane-1"),
+            # annotations: two gp_fit windows, one ea_scan
+            _x(1, 10, "gp_fit", 0, 100),
+            _x(1, 10, "ea_scan", 100, 100),
+            _x(1, 10, "gp_fit", 200, 100),
+            # device ops: nested events on lane-0 must not double count
+            _x(7, 1, "fusion.1", 10, 50),
+            _x(7, 1, "fusion.1.inner", 20, 30),
+            _x(7, 2, "fusion.2", 120, 40),
+            _x(7, 1, "fusion.3", 250, 20),
+        ]
+    }
+
+
+def test_parse_trace_lanes_annotations_and_union():
+    parsed = parse_chrome_trace(_tpu_like_trace(), {"gp_fit", "ea_scan"})
+    assert len(parsed.annotations["gp_fit"]) == 2
+    assert len(parsed.annotations["ea_scan"]) == 1
+    # nested lane events merged into one interval per lane
+    busy = parsed.device_busy
+    assert busy == [
+        (10e-6, 60e-6), (120e-6, 160e-6), (250e-6, 270e-6)
+    ]
+
+
+def test_parse_trace_cpu_backend_lanes_and_marker_exclusion():
+    trace = {
+        "traceEvents": [
+            _meta(1, pname="/host:CPU"),
+            _meta(1, tid=10, tname="python"),
+            _meta(1, tid=20, tname="tf_XLAEigen/123"),
+            _x(1, 10, "gp_fit", 0, 100),
+            # real op on the Eigen worker + zero-ish marker noise
+            _x(1, 20, "matmul.7", 10, 50),
+            _x(1, 20, "ThreadpoolListener::StartRegion", 11, 1),
+            _x(1, 20, "ThreadpoolListener::StopRegion", 60, 1),
+        ]
+    }
+    parsed = parse_chrome_trace(trace, {"gp_fit"})
+    assert parsed.device_busy == [(10e-6, 60e-6)]
+    # the python thread is a host lane: its gp_fit event is an
+    # annotation, never device busy time
+    assert parsed.annotations["gp_fit"] == [(0.0, 100e-6)]
+
+
+def test_merge_intervals():
+    assert _merge_intervals([(3, 4), (0, 1), (0.5, 2), (4, 4)]) == [
+        (0, 2), (3, 4)
+    ]
+
+
+def _span(name, span_id, t0, t1, parent=None, **labels):
+    return Span(
+        name=name, trace_id="t", span_id=span_id, parent_id=parent,
+        t_start=t0, t_end=t1, labels=labels,
+    )
+
+
+def test_ledger_join_by_name_and_order_with_tenant_attribution():
+    led = DeviceLedger()
+    # two gp_fit host spans (order matters: first gets the busy window)
+    # and tenant_cost children splitting the first one 75/25
+    spans = [
+        _span("gp_fit", 1, 100.0, 100.1, bucket="d4_o2_p16"),
+        _span("tenant_cost", 2, 100.0, 100.075, parent=1,
+              tenant="a", phase="fit"),
+        _span("tenant_cost", 3, 100.075, 100.1, parent=1,
+              tenant="b", phase="fit"),
+        _span("gp_fit", 4, 100.2, 100.3, bucket="d4_o2_p16"),
+    ]
+    cap = led.ingest_chrome_trace(_tpu_like_trace(), spans)
+    # first annotation window [0,100]us holds the merged (10,60)us op;
+    # second [200,300]us holds (250,270)us
+    rows = {(r.program, r.bucket): r for r in led.program_rows()}
+    row = rows[("gp_fit", "d4_o2_p16")]
+    assert row.n_spans == 2 and row.n_joined == 2
+    assert row.device_time_s == pytest.approx(70e-6)
+    tds = led.tenant_device_seconds()
+    assert tds["a"]["fit"] == pytest.approx(50e-6 * 0.75, rel=1e-6)
+    assert tds["b"]["fit"] == pytest.approx(50e-6 * 0.25, rel=1e-6)
+    # capture-level fractions: busy union 110us over the 300us window
+    assert cap.device_busy_fraction == pytest.approx(110 / 300, rel=1e-6)
+    # extent = first device start (10us) -> last end (270us)
+    assert cap.device_overlap_ratio == pytest.approx(110 / 260, rel=1e-6)
+    assert cap.join_fraction == 1.0
+
+
+def test_ledger_unjoined_spans_lower_join_fraction():
+    led = DeviceLedger()
+    spans = [
+        _span("gp_fit", 1, 0.0, 0.1),
+        _span("gp_fit", 2, 0.2, 0.3),
+        _span("gp_fit", 3, 0.4, 0.5),
+    ]
+    trace = {
+        "traceEvents": [
+            _meta(1, pname="/host:CPU"),
+            _meta(1, tid=10, tname="python"),
+            _x(1, 10, "gp_fit", 0, 100),  # only ONE annotation
+        ]
+    }
+    led.ingest_chrome_trace(trace, spans)
+    (row,) = led.program_rows()
+    assert row.n_spans == 3 and row.n_joined == 1
+    assert row.to_dict()["join_fraction"] == pytest.approx(1 / 3, abs=1e-4)
+
+
+def test_ledger_tail_aligns_when_spans_were_evicted():
+    """When the span buffer evicted capture-era spans, the trace holds
+    more annotation windows than surviving spans; the survivors must
+    join the most RECENT windows (the buffer drops oldest-first), not
+    silently take an earlier span's device time."""
+    led = DeviceLedger()
+    # one survivor, but TWO gp_fit windows in the trace: [0,100]us
+    # holds (10,60)us busy; [200,300]us holds (250,270)us
+    survivor = _span("gp_fit", 9, 200.0, 200.1)
+    led.ingest_chrome_trace(_tpu_like_trace(), [survivor])
+    (row,) = led.program_rows()
+    assert row.n_spans == 1 and row.n_joined == 1
+    # charged the SECOND window's 20us, not the first window's 50us
+    assert row.device_time_s == pytest.approx(20e-6)
+
+
+def test_ledger_record_compile_rows_accumulate():
+    led = DeviceLedger()
+    led.record_compile("ea_scan", 0.5, flops=100.0, bucket="d4_o2_p16")
+    led.record_compile(
+        "ea_scan", 0.25, flops=200.0, bucket="d4_o2_p16", retrace=True
+    )
+    (row,) = led.program_rows()
+    assert row.compiles == 2 and row.retraces == 1
+    assert row.compile_s == pytest.approx(0.75)
+    assert row.flops == 200.0  # latest executable wins
+    assert led.has_data
+    summary = led.summary()
+    assert summary["programs"][0]["bucket"] == "d4_o2_p16"
+    json.dumps(summary)  # JSON-able end to end
+
+
+def test_ingest_profile_dir_missing_capture_is_none(tmp_path):
+    led = DeviceLedger()
+    assert led.ingest_profile_dir(str(tmp_path), []) is None
+    assert not led.has_data
+
+
+# ----------------------------------------------- sequential-path compiles
+
+
+def test_sequential_driver_run_feeds_ledger_compiles(tmp_path):
+    """A plain (non-profiled) sequential driver run with telemetry on
+    compiles its generation-loop program OBSERVABLY: an `ea_scan`
+    ledger row with compile seconds and XLA cost/memory analysis, plus
+    `program_compile` events."""
+    params = {
+        "opt_id": "ledger_seq",
+        "obj_fun": zdt1,
+        "jax_objective": True,
+        "objective_names": ["f1", "f2"],
+        "space": {f"x{i}": [0.0, 1.0] for i in range(4)},
+        "problem_parameters": {},
+        "n_initial": 3,
+        "n_epochs": 2,
+        "population_size": 16,
+        "num_generations": 4,
+        "resample_fraction": 0.5,
+        "optimizer_name": "nsga2",
+        "surrogate_method_name": "gpr",
+        "surrogate_method_kwargs": dict(SMK),
+        "random_seed": 11,
+        "telemetry": True,
+    }
+    dmosopt_tpu.run(params, verbose=False)
+    tel = dopt_dict["ledger_seq"].telemetry
+    rows = {
+        (r.program, r.bucket): r for r in tel.ledger.program_rows()
+    }
+    row = rows[("ea_scan", None)]
+    assert row.compiles >= 1
+    assert row.compile_s > 0
+    # the CPU backend reports both analyses for AOT-compiled programs
+    assert row.flops is not None and row.flops > 0
+    assert row.memory_bytes is not None and row.memory_bytes > 0
+    events = tel.log.records(kind="program_compile")
+    assert len(events) >= 1
+    assert events[0].fields["program"] == "ea_scan"
+    assert events[0].fields["compile_s"] > 0
+
+
+# -------------------------------------------------- acceptance (service)
+
+
+@pytest.fixture(scope="module")
+def profiled_service(tmp_path_factory):
+    """The acceptance workload: a 2-bucket, 3-tenant service whose
+    step 1 runs under a jax.profiler capture on the CPU backend."""
+    prof_dir = str(tmp_path_factory.mktemp("prof"))
+    svc = OptimizationService(
+        min_bucket=1,
+        telemetry={"profile_dir": prof_dir, "profile_epochs": [1]},
+    )
+
+    def submit(dim, seed, n_epochs):
+        return svc.submit(
+            zdt1,
+            {f"x{i}": [0.0, 1.0] for i in range(dim)},
+            ["f1", "f2"],
+            n_epochs=n_epochs,
+            population_size=16,
+            num_generations=4,
+            n_initial=3,
+            surrogate_method_kwargs=dict(SMK),
+            random_seed=seed,
+        )
+
+    submit(4, 1, 3)
+    submit(4, 2, 3)
+    submit(6, 3, 3)
+    svc.run()
+    snap = svc.introspect()
+    yield svc, snap
+    svc.close()
+
+
+def test_profiled_service_ledger_joins_90_percent(profiled_service):
+    """Acceptance: per-program device times join >= 90% of
+    gp_fit/ea_scan host spans by annotation name on the CPU backend's
+    profiler output, and the trace-derived fractions are exposed
+    through introspect()."""
+    _, snap = profiled_service
+    dl = snap.get("device_ledger")
+    assert dl is not None, "profiled step produced no ledger data"
+    assert dl["captures"] >= 1
+    rows = {
+        (r["program"], r.get("bucket")): r for r in dl["programs"]
+    }
+    fit_ea = [
+        r for (name, _), r in rows.items() if name in ("gp_fit", "ea_scan")
+    ]
+    assert fit_ea, sorted(rows)
+    n_spans = sum(r["n_spans"] for r in fit_ea)
+    n_joined = sum(r["n_joined"] for r in fit_ea)
+    assert n_spans > 0
+    assert n_joined / n_spans >= 0.9, (n_joined, n_spans)
+    # device time actually accrued to the joined programs
+    assert sum(r["device_time_s"] for r in fit_ea) > 0
+    # trace-derived fractions, from device events
+    assert 0 < dl["device_busy_fraction"] <= 1.0
+    assert 0 < dl["device_overlap_ratio"] <= 1.0
+    # both buckets' EA programs recorded observable compiles
+    ea_buckets = {
+        b for (name, b) in rows if name == "ea_scan" and b is not None
+    }
+    assert {"d4_o2_p16", "d6_o2_p16"} <= ea_buckets, ea_buckets
+
+
+def test_profiled_service_attributes_tenant_device_seconds(profiled_service):
+    """Per-tenant DEVICE seconds land beside the host cost attribution:
+    every tenant that rode a profiled bucket epoch gets a share, and
+    the `tenant_device_seconds` counter carries the same totals."""
+    svc, snap = profiled_service
+    tds = snap["device_ledger"].get("tenant_device_seconds")
+    assert tds, snap["device_ledger"].keys()
+    assert len(tds) == 3
+    for tenant, phases in tds.items():
+        assert sum(phases.values()) > 0, (tenant, phases)
+    counters = svc.telemetry.registry.snapshot()["counters"].get(
+        "tenant_device_seconds", {}
+    )
+    assert counters, "tenant_device_seconds counter never incremented"
+    assert sum(counters.values()) == pytest.approx(
+        sum(sum(p.values()) for p in tds.values()), rel=1e-6
+    )
+
+
+def test_profiled_service_spans_dropped_and_gauges(profiled_service):
+    svc, snap = profiled_service
+    assert snap["spans_dropped"] == 0  # no buffer pressure at this scale
+    busy = svc.telemetry.registry.gauge_value("device_busy_fraction")
+    overlap = svc.telemetry.registry.gauge_value("device_overlap_ratio")
+    assert busy is not None and 0 < busy <= 1.0
+    assert overlap is not None and 0 < overlap <= 1.0
+    caps = svc.telemetry.log.records(kind="device_capture")
+    assert len(caps) == 1
+    assert caps[0].fields["n_joined"] > 0
+
+
+def test_status_cli_renders_device_ledger(profiled_service, tmp_path):
+    """The `status` CLI renders the device-truth block: busy/overlap
+    fractions, per-program device seconds, per-tenant device totals,
+    and the spans_dropped field."""
+    _, snap = profiled_service
+    status_file = tmp_path / "status.json"
+    status_file.write_text(json.dumps(snap, default=str))
+    result = CliRunner().invoke(status, ["-p", str(status_file)])
+    assert result.exit_code == 0, result.output
+    out = result.output
+    assert "device: busy_fraction=" in out
+    assert "overlap_ratio=" in out
+    assert "program ea_scan" in out
+    assert "tenant device seconds:" in out
+    assert "spans_dropped=0" in out

@@ -1,0 +1,308 @@
+"""tools/perfdiff.py: the contention-immune bench regression gate
+(ISSUE 12) on fixture histories — a clean pass, a seeded device-time
+regression turning red, and the BENCH_r04/r05 replay: a wall-only
+regression under recorded contention (or on the CPU fallback) reads
+`host_contended`/`cpu_fallback` instead of failing.
+"""
+
+import copy
+import json
+
+from tools.perfdiff import (
+    comparable,
+    diff,
+    flatten_metrics,
+    load_history,
+    main,
+    row_contended,
+)
+
+NCPU = 8
+
+
+def _row(**over):
+    """One bench-result row with full self-id provenance, idle host,
+    real backend (the shape bench.py appends to BENCH_HISTORY.jsonl)."""
+    row = {
+        "metric": "zdt1_nsga2_generations_per_sec",
+        "value": 3700.0,
+        "backend": "tpu",
+        "cpu_fallback": False,
+        "device_kind": "TPU v4",
+        "device_count": 4,
+        "cpu_count": NCPU,
+        "loadavg_start": [1.0, 1.0, 1.0],
+        "loadavg_end": [1.2, 1.0, 1.0],
+        "configs": {
+            "multi_tenant": {
+                "tenants_64": {"wall_sec": 10.0, "tenants_per_sec": 6.4},
+                "device": {
+                    "device_busy_fraction": 0.8,
+                    "programs": {
+                        "ea_scan[d4_o2_p16]": {
+                            "device_time_s": 2.0,
+                            "compile_s": 1.0,
+                        },
+                        "gp_fit": {"device_time_s": 3.0},
+                    },
+                },
+            },
+            "zdt1_agemoea_gpr": {"wall_sec": 90.0},
+        },
+    }
+    row.update(over)
+    return row
+
+
+def test_flatten_classifies_wall_and_device_metrics():
+    m = flatten_metrics(_row())
+    assert m["value"] == (3700.0, "wall", "higher")
+    assert m["configs.multi_tenant.tenants_64.wall_sec"] == (
+        10.0, "wall", "lower"
+    )
+    assert m["configs.multi_tenant.tenants_64.tenants_per_sec"] == (
+        6.4, "wall", "higher"
+    )
+    key = (
+        "configs.multi_tenant.device.programs.ea_scan[d4_o2_p16]"
+        ".device_time_s"
+    )
+    assert m[key] == (2.0, "device", "lower")
+    # informational leaves are never gated
+    assert not any("device_busy_fraction" in k for k in m)
+    assert not any(k.endswith("compile_s") for k in m)
+
+
+def test_comparability_rules():
+    run = _row()
+    assert comparable(run, _row())
+    assert not comparable(run, _row(backend="cpu"))
+    assert not comparable(run, _row(cpu_fallback=True))
+    assert not comparable(run, _row(device_kind="TPU v5e"))
+    # rows without device_kind (pre-ISSUE-12 history) stay comparable
+    old = _row()
+    del old["device_kind"]
+    assert comparable(run, old)
+    # TPU device events are host-independent: core count never splits
+    # the pool there, but CPU rows' "device" lanes are the host's own
+    # threadpool — a different core count is a different instrument
+    assert comparable(run, _row(cpu_count=NCPU * 3))
+    cpu_run = _row(backend="cpu", device_kind="cpu")
+    assert comparable(cpu_run, _row(backend="cpu", device_kind="cpu"))
+    assert not comparable(
+        cpu_run, _row(backend="cpu", device_kind="cpu", cpu_count=NCPU * 3)
+    )
+
+
+def test_contention_detection():
+    assert not row_contended(_row())
+    assert row_contended(_row(loadavg_end=[NCPU * 2.0, 1.0, 1.0]))
+
+
+def test_clean_history_passes():
+    history = [_row(), _row()]
+    report = diff(_row(), history)
+    assert report["status"] == "pass"
+    assert report["n_comparable"] == 2
+    assert all(c["status"] in ("ok", "improved") for c in report["checks"])
+
+
+def test_seeded_device_regression_fails_even_under_contention():
+    """Device-time regressions gate hard: host contention cannot
+    inflate device events, so even a contended run fails on one."""
+    bad = _row(loadavg_end=[NCPU * 2.0, 1.0, 1.0])  # contended AND
+    bad["configs"]["multi_tenant"]["device"]["programs"][
+        "ea_scan[d4_o2_p16]"
+    ]["device_time_s"] = 4.0  # 2x the baseline's 2.0s device time
+    report = diff(bad, [_row()])
+    assert report["status"] == "fail"
+    failing = [
+        c for c in report["checks"] if c["status"] == "device_regression"
+    ]
+    assert len(failing) == 1
+    assert failing[0]["metric"].endswith("device_time_s")
+    assert failing[0]["kind"] == "device"
+
+
+def test_device_regression_on_contended_cpu_backend_is_suspect():
+    """The CPU backend's \"device lanes\" are XLA's Eigen host threads,
+    which contention stretches like any wall — a contended CPU run's
+    device regression must classify suspect, not fail. On an IDLE CPU
+    host the same regression still gates hard (CPU execute time is
+    meaningful there)."""
+    base = _row(backend="cpu", device_kind="cpu")
+
+    def seeded(**over):
+        bad = _row(backend="cpu", device_kind="cpu", **over)
+        bad["configs"]["multi_tenant"]["device"]["programs"][
+            "ea_scan[d4_o2_p16]"
+        ]["device_time_s"] = 4.0
+        return bad
+
+    contended = diff(
+        seeded(loadavg_end=[NCPU * 2.0, 1.0, 1.0]), [base]
+    )
+    assert contended["status"] == "suspect"
+    assert not any(
+        c["status"] == "device_regression" for c in contended["checks"]
+    )
+    idle = diff(seeded(), [base])
+    assert idle["status"] == "fail"
+    assert any(
+        c["status"] == "device_regression" for c in idle["checks"]
+    )
+
+
+def test_tiny_device_delta_below_absolute_floor_never_gates():
+    """A 3x ratio on a 20ms program is a 40ms delta — scheduler noise,
+    not a regression; the absolute floor keeps it from hard-failing."""
+    base = _row()
+    base["configs"]["multi_tenant"]["device"]["programs"][
+        "ea_scan[d4_o2_p16]"
+    ]["device_time_s"] = 0.02
+    noisy = copy.deepcopy(base)
+    noisy["configs"]["multi_tenant"]["device"]["programs"][
+        "ea_scan[d4_o2_p16]"
+    ]["device_time_s"] = 0.06
+    report = diff(noisy, [base])
+    assert report["status"] == "pass"
+    assert not any(
+        c["status"] == "device_regression" for c in report["checks"]
+    )
+
+
+def test_wall_regression_on_idle_real_backend_fails():
+    bad = _row()
+    bad["configs"]["multi_tenant"]["tenants_64"]["wall_sec"] = 30.0
+    report = diff(bad, [_row()])
+    assert report["status"] == "fail"
+    assert any(
+        c["status"] == "wall_regression" for c in report["checks"]
+    )
+
+
+def test_wall_only_regression_under_contention_reads_host_contended():
+    """The BENCH_r04/r05 replay: walls 3x inflated, loadavg recorded
+    above 1.5x cores, device times UNCHANGED — suspect, never failing."""
+    bad = _row(loadavg_end=[NCPU * 3.0, NCPU * 2.0, NCPU])
+    bad["configs"]["multi_tenant"]["tenants_64"]["wall_sec"] = 30.0
+    bad["configs"]["zdt1_agemoea_gpr"]["wall_sec"] = 400.0
+    bad["value"] = 900.0
+    report = diff(bad, [_row()])
+    assert report["status"] == "suspect"
+    statuses = {c["status"] for c in report["checks"]}
+    assert "host_contended" in statuses
+    assert "wall_regression" not in statuses
+    assert "device_regression" not in statuses
+
+
+def test_cpu_fallback_wall_regression_is_suspect_not_failing():
+    """The other half of the r04/r05 trap: a CPU-fallback run's walls
+    are incomparable to accelerator baselines by construction; within
+    its own (cpu_fallback) pool a wall regression is still suspect."""
+    base = _row(cpu_fallback=True, backend="cpu")
+    bad = copy.deepcopy(base)
+    bad["configs"]["multi_tenant"]["tenants_64"]["wall_sec"] = 30.0
+    report = diff(bad, [base])
+    assert report["status"] == "suspect"
+    assert any(c["status"] == "cpu_fallback" for c in report["checks"])
+
+
+def test_no_comparable_baseline_passes():
+    report = diff(_row(backend="tpu"), [_row(backend="cpu")])
+    assert report["status"] == "no_baseline"
+
+
+def _write_history(path, rows):
+    with open(path, "w") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+
+
+def test_load_history_skips_smoke_partial_fault_and_corrupt(tmp_path):
+    p = tmp_path / "h.jsonl"
+    with open(p, "w") as fh:
+        fh.write(json.dumps(_row()) + "\n")
+        fh.write("not json\n")
+        fh.write(json.dumps(_row(smoke=True)) + "\n")
+        fh.write(json.dumps(_row(partial=True)) + "\n")
+        fh.write(json.dumps(_row(fault_plan="seed=1")) + "\n")
+        fh.write(
+            json.dumps(
+                _row(value=0.0, configs={}, error="bench child died")
+            )
+            + "\n"
+        )
+        fh.write("\n")
+    rows = load_history(str(p))
+    assert len(rows) == 1
+
+
+def test_missing_device_metrics_read_missing_in_run():
+    """A device metric every baseline knows but the fresh run did not
+    record (capture failed / DMOSOPT_BENCH_DEVICE=0) must surface as a
+    `missing_in_run` suspect check, never silently pass — while a
+    config absent wholesale (subset run) flags nothing."""
+    gap = _row()
+    del gap["configs"]["multi_tenant"]["device"]  # config ran, no capture
+    report = diff(gap, [_row()])
+    assert report["status"] == "suspect"
+    missing = [
+        c for c in report["checks"] if c["status"] == "missing_in_run"
+    ]
+    assert {c["metric"] for c in missing} == {
+        "configs.multi_tenant.device.programs.ea_scan[d4_o2_p16]"
+        ".device_time_s",
+        "configs.multi_tenant.device.programs.gp_fit.device_time_s",
+    }
+    assert all(c["kind"] == "device" and c["value"] is None for c in missing)
+    # render must handle the value-less checks
+    from tools.perfdiff import render
+
+    assert "missing_in_run" in render(report)
+
+    subset = _row()
+    del subset["configs"]["multi_tenant"]  # whole config skipped
+    report = diff(subset, [_row()])
+    assert report["status"] == "pass"
+    assert not any(
+        c["status"] == "missing_in_run" for c in report["checks"]
+    )
+
+
+def test_cli_clean_pass_and_seeded_regression_exit_codes(tmp_path, capsys):
+    """The `make bench-diff` entry point: last history row judged
+    against the rows before it."""
+    clean = tmp_path / "clean.jsonl"
+    _write_history(clean, [_row(), _row()])
+    assert main(["--history", str(clean)]) == 0
+    assert "status=pass" in capsys.readouterr().out
+
+    bad_row = _row()
+    bad_row["configs"]["multi_tenant"]["device"]["programs"]["gp_fit"][
+        "device_time_s"
+    ] = 9.0
+    red = tmp_path / "red.jsonl"
+    _write_history(red, [_row(), bad_row])
+    assert main(["--history", str(red)]) == 1
+    assert "device_regression" in capsys.readouterr().out
+
+    contended = _row(loadavg_end=[NCPU * 3.0, 1.0, 1.0])
+    contended["configs"]["multi_tenant"]["tenants_64"]["wall_sec"] = 40.0
+    sus = tmp_path / "sus.jsonl"
+    _write_history(sus, [_row(), contended])
+    assert main(["--history", str(sus)]) == 0
+    assert "host_contended" in capsys.readouterr().out
+
+
+def test_cli_explicit_run_file_and_empty_history(tmp_path, capsys):
+    run_file = tmp_path / "run.json"
+    run_file.write_text(json.dumps(_row()))
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert main(
+        ["--history", str(empty), "--run", str(run_file)]
+    ) == 0
+    assert "no_baseline" in capsys.readouterr().out
+    # empty history, no --run: clean no-op
+    assert main(["--history", str(empty)]) == 0

@@ -186,6 +186,105 @@ def test_jsonl_roundtrip(tmp_path):
     assert all("ts" in d and "kind" in d for d in lines)
 
 
+def test_jsonl_sink_rotates_at_max_bytes(tmp_path):
+    """ISSUE 12 satellite: a size-bounded sink rotates instead of
+    growing unbounded — the live file becomes `.1` (shifting existing
+    rotated files), at most `keep` rotated files survive, and every
+    event is still on disk across the chain until age drops it."""
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(ring_size=16, jsonl_path=path, max_bytes=256, keep=2)
+    rotations_seen = []
+    log.on_rotate = lambda: rotations_seen.append(1)
+    n = 40
+    for i in range(n):
+        log.emit("phase", epoch=i, phase="train", duration_s=1.0)
+    log.close()
+
+    assert log.rotations >= 2
+    assert len(rotations_seen) == log.rotations
+    import os as _os
+
+    files = [path, path + ".1", path + ".2"]
+    assert all(_os.path.exists(f) for f in files)
+    assert not _os.path.exists(path + ".3")  # keep=2 bounds the chain
+    # every retained file honors the byte bound (one event may overhang
+    # the live file before its next write triggers rotation, so allow
+    # one line of slack) and holds valid JSONL
+    events = []
+    for f in files:
+        size = _os.path.getsize(f)
+        lines = [l for l in open(f) if l.strip()]
+        assert lines, f
+        assert size <= 256 + len(lines[0]) + 1, (f, size)
+        events.append([json.loads(l)["epoch"] for l in lines])
+    # the chain reads newest-first: live file, then .1, then .2 — and
+    # every retained file holds contiguous ascending epochs
+    flat = [e for per_file in reversed(events) for e in per_file]
+    assert flat == sorted(flat), flat
+    assert flat[-1] == n - 1
+
+
+def test_jsonl_rotation_counted_by_telemetry(tmp_path):
+    """The facade wires `on_rotate` to the cataloged
+    `telemetry_sink_rotations_total` counter."""
+    tel = Telemetry(
+        jsonl_path=str(tmp_path / "e.jsonl"),
+        jsonl_max_bytes=200,
+        jsonl_keep=1,
+    )
+    for i in range(30):
+        tel.event("phase", epoch=i, phase="train", duration_s=1.0)
+    tel.close()
+    assert tel.log.rotations >= 1
+    assert tel.registry.counter_value(
+        "telemetry_sink_rotations_total"
+    ) == tel.log.rotations
+
+
+def test_jsonl_rotation_counted_when_reopen_fails(tmp_path, monkeypatch):
+    """A rotation whose renames succeeded but whose live-file reopen
+    failed (disk-full/EMFILE) DID happen on disk: it must count in
+    `rotations` and fire `on_rotate` — the counter has to agree with
+    the on-disk state it explains — while the sink goes dark instead
+    of crashing the next emit."""
+    import builtins
+
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(ring_size=32, jsonl_path=path, max_bytes=128, keep=2)
+    rotations_seen = []
+    log.on_rotate = lambda: rotations_seen.append(1)
+
+    real_open = builtins.open
+    fail = {"armed": True}
+
+    def flaky_open(file, mode="r", *a, **kw):
+        if fail["armed"] and file == path and "a" in mode:
+            raise OSError("disk full")
+        return real_open(file, mode, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    for i in range(20):  # enough bytes to cross max_bytes and rotate
+        log.emit("phase", epoch=i, phase="train", duration_s=1.0)
+    monkeypatch.setattr(builtins, "open", real_open)
+
+    import os as _os
+
+    assert _os.path.exists(path + ".1")  # the chain really moved
+    assert log.rotations == 1
+    assert len(rotations_seen) == 1
+    assert log._fh is None  # dark, but emit survived
+    assert len(log.records()) == 20  # ring buffer kept everything
+    log.close()
+
+
+def test_jsonl_unbounded_sink_never_rotates(tmp_path):
+    log = EventLog(ring_size=16, jsonl_path=str(tmp_path / "e.jsonl"))
+    for i in range(50):
+        log.emit("phase", epoch=i, phase="train", duration_s=1.0)
+    log.close()
+    assert log.rotations == 0
+
+
 def test_jsonl_crash_tail_survives_kill(tmp_path):
     """Satellite: the JSONL sink flushes on every `phase` close, so a
     run killed WITHOUT `close()` keeps everything up to its last

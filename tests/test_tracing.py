@@ -175,6 +175,48 @@ def test_validate_chrome_trace_catches_breakage():
     assert any("negative" in p for p in validate_chrome_trace(negative))
 
 
+def test_chrome_export_under_buffer_overflow_stays_schema_valid(tmp_path):
+    """ISSUE 12 satellite: fill PAST the bounded span buffer with
+    nested spans, so evicted parents leave children behind — the export
+    must stay schema-valid (dangling parent links dropped, the orphan
+    becomes a root in the exported window) and `spans_dropped` must
+    account exactly for the loss."""
+    max_spans = 8
+    n_epochs = 10  # 10 epochs x 3 spans = 30 spans through an 8-slot buffer
+    tr = Tracer(path=str(tmp_path / "overflow.trace.json"), max_spans=max_spans)
+    for i in range(n_epochs):
+        with tr.span("epoch", epoch=i):
+            with tr.span("gp_fit", epoch=i):
+                pass
+            with tr.span("ea_scan", epoch=i):
+                pass
+    total = n_epochs * 3
+    assert len(tr.spans()) == max_spans
+    # exact accounting: every span past the buffer bound was counted
+    assert tr.spans_dropped == total - max_spans
+
+    trace = load_chrome_trace(tr.export())
+    assert validate_chrome_trace(trace) == []
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == max_spans
+    assert trace["otherData"]["spans_dropped"] == total - max_spans
+    # the kept window is the run's TAIL, and at least one surviving
+    # child kept its (surviving) parent link while the oldest kept
+    # child of an evicted epoch became a root rather than dangling
+    epochs_seen = {e["args"]["epoch"] for e in xs}
+    assert max(epochs_seen) == n_epochs - 1
+    by_id = {e["args"]["span_id"]: e for e in xs}
+    for e in xs:
+        parent = e["args"].get("parent_id")
+        if parent is not None:
+            assert parent in by_id
+    roots = [
+        e for e in xs
+        if e["name"] != "epoch" and "parent_id" not in e["args"]
+    ]
+    assert roots, "expected at least one orphaned child re-rooted"
+
+
 def test_span_scope_disabled_paths_are_noops():
     with span_scope(None, "epoch") as sp:
         assert sp is None

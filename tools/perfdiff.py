@@ -1,0 +1,385 @@
+"""Contention-immune bench regression gate over BENCH_HISTORY.jsonl.
+
+The project's own history is the motivation: BENCH_r04/r05 walls were
+3-9x inflated by host contention AND silently ran on the CPU fallback,
+and both rounds read as catastrophic regressions until someone manually
+re-measured on an idle host. This tool mechanizes that lesson:
+
+- **Device-time regressions gate hard.** Metrics under a config's
+  ``device`` subtree (the device-time ledger's per-program device
+  seconds, recorded by profiled bench runs) come from device events,
+  which host contention cannot inflate on a real accelerator — a
+  regression there is real even on a loaded host, so it FAILS the
+  diff. Exception: the CPU backend's "device lanes" are XLA's Eigen
+  host threadpool, which contention stretches like any wall — a
+  contended CPU run's device regression is only suspect.
+- **Wall regressions are only ever *suspect* on a compromised run.**
+  When the fresh run records ``loadavg > 1.5 x cores`` or ran on the
+  CPU fallback (``cpu_fallback``/``backend`` self-id, carried by every
+  bench row since PR 6), a wall-clock regression classifies as
+  ``host_contended`` / ``cpu_fallback`` — reported, exit 0, re-measure
+  idle before believing it. Only a wall regression on an apparently
+  idle, real-backend run fails.
+- Rows are only compared against **comparable** history: same backend,
+  same fallback status, same ``device_kind`` (when recorded) — a TPU
+  wall is never judged against a CPU baseline. CPU rows further
+  require the same ``cpu_count`` (their "device" lanes are the host's
+  own threadpool), and device deltas under an absolute 50 ms floor
+  never gate — scheduler noise on sub-second programs is not a
+  regression however large the ratio reads.
+
+Usage (see ``make bench-diff``)::
+
+    python tools/perfdiff.py --history BENCH_HISTORY.jsonl [--run fresh.json]
+
+Without ``--run``, the LAST history row is the fresh run and the rows
+before it are the baseline pool. Exit status: 0 = pass (including
+suspect-only and no-baseline outcomes), 1 = at least one hard failure.
+Smoke/partial/fault-injected rows never enter the comparison.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: the r04/r05 contention threshold (matching bench.py's warning and
+#: `OptimizationService._throughput_check`)
+CONTENTION_LOAD_RATIO = 1.5
+#: default regression tolerances (ratio worse-than-baseline); walls get
+#: more slack than device times because host scheduling noise is real
+#: even on an idle box
+WALL_TOLERANCE = 1.5
+DEVICE_TOLERANCE = 1.3
+#: absolute noise floor for device-time deltas: sub-50ms swings on
+#: sub-second programs are scheduler/measurement noise, not
+#: regressions — without this a 20ms program going to 50ms (2.5x)
+#: would hard-fail the gate on jitter
+DEVICE_ABS_FLOOR_S = 0.05
+
+#: metric-key suffixes measured by host wall clocks, lower is better
+_WALL_LOWER_SUFFIXES = (
+    "wall_sec", "wall_s", "_sec_per_gen", "step_sec", "fit_sec",
+)
+#: host-clock throughputs, higher is better
+_WALL_HIGHER_SUFFIXES = ("per_sec", "gens_per_sec")
+#: device-truth seconds (inside a "device" subtree), lower is better
+_DEVICE_LOWER_SUFFIXES = ("device_time_s", "device_seconds", "device_busy_s")
+
+
+def load_history(path: str) -> List[Dict[str, Any]]:
+    """Parse a BENCH_HISTORY.jsonl file into comparable rows, skipping
+    blank/corrupt lines and rows that must never serve as baselines
+    (smoke runs, salvaged partials, fault-injection rounds, failed-run
+    error stubs)."""
+    rows: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(row, dict):
+                continue
+            if (
+                row.get("smoke")
+                or row.get("partial")
+                or row.get("fault_plan")
+                or row.get("error")
+            ):
+                continue
+            rows.append(row)
+    return rows
+
+
+def row_contended(row: Dict[str, Any]) -> bool:
+    """The r04/r05 signature, read from the row's own self-id: 1-minute
+    loadavg above 1.5x cores at either end of the run."""
+    ncpu = row.get("cpu_count") or os.cpu_count() or 1
+    for key in ("loadavg_end", "loadavg_start", "loadavg"):
+        la = row.get(key)
+        if isinstance(la, (list, tuple)) and la:
+            if float(la[0]) > CONTENTION_LOAD_RATIO * ncpu:
+                return True
+    return False
+
+
+def comparable(run: Dict[str, Any], row: Dict[str, Any]) -> bool:
+    """May `row` serve as a baseline for `run`? Same backend, same
+    fallback status, and same device_kind when both rows recorded one.
+    CPU rows additionally require the same core count: a CPU backend's
+    "device" lanes are the host's own Eigen threadpool, so its device
+    times are host-class-dependent — judging a 4-core laptop against a
+    24-core seed row would hard-fail the device gate on host speed,
+    the exact false-regression class this tool exists to prevent."""
+    if row.get("backend") != run.get("backend"):
+        return False
+    if bool(row.get("cpu_fallback")) != bool(run.get("cpu_fallback")):
+        return False
+    dk_run, dk_row = run.get("device_kind"), row.get("device_kind")
+    if dk_run is not None and dk_row is not None and dk_run != dk_row:
+        return False
+    if run.get("backend") == "cpu" or run.get("cpu_fallback"):
+        nc_run, nc_row = run.get("cpu_count"), row.get("cpu_count")
+        if nc_run is not None and nc_row is not None and nc_run != nc_row:
+            return False
+    return True
+
+
+def _classify(path: Tuple[str, ...], key: str) -> Optional[Tuple[str, str]]:
+    """(kind, direction) for one metric leaf, or None when the leaf is
+    informational (never gated). kind: "device" | "wall"; direction:
+    "lower" | "higher" (better)."""
+    in_device = "device" in path
+    if in_device:
+        if any(key.endswith(s) for s in _DEVICE_LOWER_SUFFIXES):
+            return ("device", "lower")
+        return None  # fractions/compile seconds: informational
+    if any(key.endswith(s) for s in _WALL_LOWER_SUFFIXES):
+        return ("wall", "lower")
+    if any(key.endswith(s) for s in _WALL_HIGHER_SUFFIXES):
+        return ("wall", "higher")
+    return None
+
+
+def flatten_metrics(result: Dict[str, Any]) -> Dict[str, Tuple[float, str, str]]:
+    """{dotted.path: (value, kind, direction)} over every gated numeric
+    leaf of a bench result row: the headline ``value`` plus everything
+    under ``configs``."""
+    out: Dict[str, Tuple[float, str, str]] = {}
+
+    def walk(node, path: Tuple[str, ...]):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (str(k),))
+            return
+        if not isinstance(node, (int, float)) or isinstance(node, bool):
+            return
+        cls = _classify(path[:-1], path[-1])
+        if cls is not None and node > 0:
+            out[".".join(path)] = (float(node), cls[0], cls[1])
+
+    walk(result.get("configs", {}), ("configs",))
+    v = result.get("value")
+    if isinstance(v, (int, float)) and v > 0:
+        out["value"] = (float(v), "wall", "higher")
+    return out
+
+
+def diff(
+    run: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    wall_tolerance: float = WALL_TOLERANCE,
+    device_tolerance: float = DEVICE_TOLERANCE,
+) -> Dict[str, Any]:
+    """Compare one fresh bench row against its comparable history.
+
+    Returns a JSON-able report: per-metric checks (``ok`` /
+    ``improved`` / ``device_regression`` / ``wall_regression`` /
+    ``host_contended`` / ``cpu_fallback`` / ``missing_in_run``) and an
+    overall ``status``
+    (``pass`` / ``suspect`` / ``fail`` / ``no_baseline``). Baseline per
+    metric is the BEST comparable historical value — a regression means
+    "worse than this machine has ever measured", the strictest honest
+    gate a noisy host allows."""
+    pool = [row for row in history if comparable(run, row)]
+    report: Dict[str, Any] = {
+        "n_history": len(history),
+        "n_comparable": len(pool),
+        "contended": row_contended(run),
+        "cpu_fallback": bool(run.get("cpu_fallback")),
+        "checks": [],
+    }
+    if not pool:
+        report["status"] = "no_baseline"
+        return report
+
+    run_metrics = flatten_metrics(run)
+    # "device" lanes on the CPU backend are XLA's Eigen host threadpool
+    # — contention-inflatable, unlike real accelerator op timelines
+    cpu_lanes = run.get("backend") == "cpu" or report["cpu_fallback"]
+    baselines: Dict[str, List[float]] = {}
+    for row in pool:
+        for key, (v, _, _) in flatten_metrics(row).items():
+            baselines.setdefault(key, []).append(v)
+
+    worst = "pass"
+    for key, (v, kind, direction) in sorted(run_metrics.items()):
+        base_vals = baselines.get(key)
+        if not base_vals:
+            continue
+        best = min(base_vals) if direction == "lower" else max(base_vals)
+        if best <= 0:
+            continue
+        # ratio > 1 means WORSE than baseline, either direction
+        ratio = (v / best) if direction == "lower" else (best / v)
+        tol = device_tolerance if kind == "device" else wall_tolerance
+        if ratio <= 1.0:
+            status = "improved" if ratio < 1.0 else "ok"
+        elif ratio <= tol:
+            status = "ok"
+        elif kind == "device" and (v - best) < DEVICE_ABS_FLOOR_S:
+            # sub-floor absolute delta on a tiny program: noise, not
+            # a regression, however large the ratio reads
+            status = "ok"
+        elif kind == "device" and not (cpu_lanes and report["contended"]):
+            # device events on a real accelerator cannot be inflated by
+            # host contention: a device-time regression gates hard even
+            # on a loaded host. The one exception is the CPU backend,
+            # whose "device lanes" are XLA's Eigen host threads — under
+            # contention those stretch like any wall, so a contended
+            # CPU run's device regression is only suspect (below)
+            status = "device_regression"
+        elif report["cpu_fallback"]:
+            status = "cpu_fallback"
+        elif report["contended"]:
+            status = "host_contended"
+        else:
+            status = "wall_regression"
+        report["checks"].append(
+            {
+                "metric": key,
+                "kind": kind,
+                "value": v,
+                "baseline": best,
+                "ratio_vs_best": round(ratio, 3),
+                "status": status,
+            }
+        )
+        if status in ("device_regression", "wall_regression"):
+            worst = "fail"
+        elif status in ("host_contended", "cpu_fallback") and worst != "fail":
+            worst = "suspect"
+
+    # a device-truth metric the baselines know but the fresh run did
+    # not record (capture failed, DMOSOPT_BENCH_DEVICE=0) must not
+    # vanish from the gate silently — the hard device gate only works
+    # when absence is loud. Only flagged when the metric's config DID
+    # run this round; a config absent wholesale (subset run) is not a
+    # capture gap.
+    run_configs = {
+        key.split(".")[1]
+        for key in run_metrics
+        if key.startswith("configs.")
+    }
+    for key in sorted(baselines):
+        if key in run_metrics:
+            continue
+        parts = key.split(".")
+        cls = _classify(tuple(parts[:-1]), parts[-1])
+        if cls is None or cls[0] != "device":
+            continue
+        if len(parts) < 2 or parts[0] != "configs":
+            continue
+        if parts[1] not in run_configs:
+            continue
+        report["checks"].append(
+            {
+                "metric": key,
+                "kind": "device",
+                "value": None,
+                "baseline": min(baselines[key]),
+                "ratio_vs_best": None,
+                "status": "missing_in_run",
+            }
+        )
+        if worst != "fail":
+            worst = "suspect"
+
+    report["status"] = worst
+    return report
+
+
+def render(report: Dict[str, Any]) -> str:
+    lines = [
+        f"perfdiff: status={report['status']} "
+        f"(history={report['n_history']}, "
+        f"comparable={report['n_comparable']}, "
+        f"contended={report.get('contended', False)}, "
+        f"cpu_fallback={report.get('cpu_fallback', False)})"
+    ]
+    notable = [
+        c for c in report.get("checks", []) if c["status"] not in ("ok",)
+    ]
+    for c in notable:
+        if c["status"] == "missing_in_run":
+            lines.append(
+                f"  [{c['status']:>17}] {c['metric']}: not recorded by "
+                f"this run (baseline best {c['baseline']:.4g}) — device "
+                f"capture failed or disabled; the device gate did not run"
+            )
+            continue
+        lines.append(
+            f"  [{c['status']:>17}] {c['metric']}: {c['value']:.4g} "
+            f"vs best {c['baseline']:.4g} "
+            f"({c['ratio_vs_best']:.2f}x worse-ratio, {c['kind']})"
+        )
+    if report["status"] == "no_baseline":
+        lines.append(
+            "  no comparable baseline rows (backend/device mismatch or "
+            "empty history) — nothing to gate against"
+        )
+    if report["status"] == "suspect":
+        lines.append(
+            "  suspect, not failing: compromised-run wall regressions "
+            "(contended host / CPU fallback — walls can be 3-9x "
+            "inflated, BENCH_r04/r05) and unrecorded device metrics; "
+            "re-measure on an idle host with the real backend and "
+            "device capture enabled before trusting this"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--history", default="BENCH_HISTORY.jsonl",
+        help="committed bench history (JSON lines of bench.py results)",
+    )
+    ap.add_argument(
+        "--run", default=None,
+        help="fresh bench result JSON file; default: the history's last "
+             "row, judged against the rows before it",
+    )
+    ap.add_argument("--wall-tolerance", type=float, default=WALL_TOLERANCE)
+    ap.add_argument("--device-tolerance", type=float, default=DEVICE_TOLERANCE)
+    ap.add_argument(
+        "--json", action="store_true", help="emit the full report as JSON"
+    )
+    args = ap.parse_args(argv)
+
+    history = load_history(args.history)
+    if args.run:
+        with open(args.run) as fh:
+            run = json.load(fh)
+    else:
+        if not history:
+            print(
+                f"perfdiff: status=no_baseline (history {args.history!r} "
+                f"has no comparable rows and no --run was given)"
+            )
+            return 0
+        run, history = history[-1], history[:-1]
+
+    report = diff(
+        run, history,
+        wall_tolerance=args.wall_tolerance,
+        device_tolerance=args.device_tolerance,
+    )
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+    return 1 if report["status"] == "fail" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
