@@ -58,6 +58,15 @@ trace-smoke:
 chaos:
 	$(PY) tools/chaos_smoke.py
 
+# health gate: deterministic alerting pinned both ways — a seeded
+# chaos plan (hang + NaN tenants) must fire EXACTLY the expected alert
+# set (rule names + severities) and resolve it once the faulty tenants
+# retire, and a fault-free run must fire nothing
+# (docs/observability.md "Run-health engine"; mirrored in the fast
+# suite by tests/test_health.py)
+health-smoke:
+	$(PY) tools/health_smoke.py
+
 bench:
 	python bench.py
 

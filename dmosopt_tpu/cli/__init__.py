@@ -1,4 +1,5 @@
-"""Command-line tools: analyze / train / onestep / telemetry / status.
+"""Command-line tools: analyze / train / onestep / telemetry / status /
+fleet.
 
 Capability match: the reference ships three click commands —
 `dmosopt-analyze` (Pareto extraction + kNN-to-origin ranking,
@@ -10,7 +11,11 @@ same intent against the dmosopt_tpu HDF5 schema. `telemetry` is new:
 it renders the per-epoch observability summaries the driver persists
 (docs/observability.md) as a phase/throughput table. `status` renders
 the live-service introspection snapshot an
-`OptimizationService(status_path=...)` publishes after every step.
+`OptimizationService(status_path=...)` publishes after every step
+(with `--watch N` as a live re-rendering dashboard, including the
+health-alert block). `fleet` rolls N stores' persisted telemetry into
+per-problem-signature distributions — the fleet-learned-prior
+substrate.
 """
 
 from __future__ import annotations
@@ -403,18 +408,46 @@ def telemetry(file_path, opt_id, problem_id, with_hv, output_file):
                    "(OptimizationService(status_path=...))")
 @click.option("--as-json", "as_json", is_flag=True,
               help="emit the raw snapshot JSON instead of the table")
-def status(status_file, as_json):
+@click.option("--watch", "-w", default=0.0, type=float,
+              help="re-render from the status file every N seconds "
+                   "(live operation; Ctrl-C to stop)")
+def status(status_file, as_json, watch):
     """Live-service introspection: render the snapshot an
     `OptimizationService(status_path=...)` publishes after every step —
     tenants with epoch/state/attributed cost, queue depths, writer
-    backlog, telemetry series-overflow state, and the loadavg-normalized
-    throughput check (docs/observability.md)."""
-    with open(status_file) as fh:
-        snap = json.load(fh)
-    if as_json:
-        click.echo(json.dumps(snap, indent=2, default=json_default))
-        return
+    backlog, telemetry series-overflow state, the health-alert block,
+    and the loadavg-normalized throughput check (docs/observability.md).
+    With `--watch N` the table re-renders from the status file every N
+    seconds — the zero-dependency live dashboard."""
+    import time as _time
 
+    def render_once():
+        with open(status_file) as fh:
+            snap = json.load(fh)
+        if as_json:
+            click.echo(json.dumps(snap, indent=2, default=json_default))
+        else:
+            _render_status(snap)
+
+    if watch and watch > 0:
+        try:
+            while True:
+                click.clear()
+                render_once()
+                click.echo(
+                    f"(watching {status_file} every {watch:g}s — "
+                    f"Ctrl-C to stop)"
+                )
+                _time.sleep(watch)
+        except KeyboardInterrupt:
+            return
+    else:
+        render_once()
+
+
+def _render_status(snap):
+    """One rendering of a status snapshot (shared by the one-shot and
+    `--watch` paths)."""
     counts = snap.get("tenant_counts", {})
     counts_str = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
     qd = snap.get("queue_depths", {})
@@ -463,6 +496,30 @@ def status(status_file, as_json):
     click.echo(line)
     if thr.get("note"):
         click.echo(f"  note: {thr['note']}")
+    health = snap.get("health")
+    if health is not None:
+        hstatus = health.get("status", "ok")
+        firing = health.get("firing", [])
+        click.echo(
+            f"health: {hstatus} "
+            f"({len(firing)} firing / {health.get('rules', 0)} rules, "
+            f"{health.get('transitions_total', 0)} transitions)"
+        )
+        for alert in firing:
+            since = alert.get("since_step")
+            val = alert.get("value")
+            click.echo(
+                f"  ALERT [{alert.get('severity', '?')}] "
+                f"{alert.get('rule', '?')}"
+                + (f" since step {since}" if since is not None else "")
+                + (f" (value {val:g})" if isinstance(val, (int, float))
+                   else "")
+            )
+    exporter = snap.get("exporter")
+    if exporter and exporter.get("url"):
+        click.echo(
+            f"exporter: {exporter['url']} (/metrics /healthz /statusz)"
+        )
     last = snap.get("last_step", {})
     if last.get("phases"):
         click.echo(
@@ -546,6 +603,84 @@ def status(status_file, as_json):
         click.echo(f"trace: {snap['trace_path']}")
 
 
+@click.command("fleet")
+@click.option("--file-path", "-p", "file_paths", required=True,
+              multiple=True, type=click.Path(exists=True),
+              help="HDF5 store to scan (repeatable; results stores and "
+                   "service checkpoints both work)")
+@click.option("--signature", "-s", default=None,
+              help="only report this problem signature (d<dim>_o<nobj>)")
+@click.option("--output-file", "-o", type=click.Path(), default=None,
+              help="write the full fleet-summary JSON here")
+@click.option("--as-json", "as_json", is_flag=True,
+              help="emit the fleet-summary JSON to stdout instead of "
+                   "the table")
+def fleet(file_paths, signature, output_file, as_json):
+    """Fleet telemetry rollup: scan N runs' persisted telemetry
+    (per-epoch summaries, spans, health alerts, warm-refit
+    hyperparameter state) into per-problem-signature distributions —
+    the substrate fleet-learned warm-start priors consume
+    (docs/observability.md "Fleet telemetry rollup")."""
+    from dmosopt_tpu.telemetry.fleet import fleet_summary, write_fleet_summary
+
+    if output_file is not None:
+        summary = write_fleet_summary(list(file_paths), output_file)
+    else:
+        summary = fleet_summary(list(file_paths))
+    if signature is not None:
+        if signature not in summary["signatures"]:
+            raise click.ClickException(
+                f"signature {signature!r} not in the fleet; present: "
+                f"{sorted(summary['signatures'])}"
+            )
+        summary = dict(
+            summary,
+            signatures={signature: summary["signatures"][signature]},
+        )
+    if as_json:
+        click.echo(json.dumps(summary, indent=2, default=json_default))
+        if output_file is not None:
+            click.echo(f"wrote {output_file}", err=True)
+        return
+
+    click.echo(
+        f"fleet: {summary['n_runs']} run(s) across "
+        f"{summary['n_stores']} store(s), "
+        f"{len(summary['signatures'])} signature(s)"
+    )
+    for sig, entry in summary["signatures"].items():
+        click.echo(f"\nsignature {sig}: {entry['n_runs']} run(s), "
+                   f"{entry['n_problems']} problem(s)")
+        for dist_key in ("epochs", "fit_steps", "gens_per_sec",
+                         "epochs_to_front", "n_train", "quarantine_rate"):
+            d = entry.get(dist_key)
+            if d:
+                click.echo(
+                    f"  {dist_key:>16}: mean={d['mean']:.4g} "
+                    f"median={d['median']:.4g} "
+                    f"[{d['min']:.4g}, {d['max']:.4g}] n={d['count']}"
+                )
+        hp = entry.get("hyperparameters", {})
+        for name in ("amp", "lengthscale", "noise"):
+            d = (hp.get(name) or {}).get("log10")
+            if d:
+                click.echo(
+                    f"  {name:>16}: log10 mean={d['mean']:.3f} "
+                    f"std={d['std']:.3f} "
+                    f"[{d['min']:.3f}, {d['max']:.3f}] n={d['count']}"
+                )
+        if entry.get("alert_firings"):
+            click.echo(
+                "  alerts: "
+                + " ".join(
+                    f"{rule}={n}"
+                    for rule, n in sorted(entry["alert_firings"].items())
+                )
+            )
+    if output_file is not None:
+        click.echo(f"\nwrote {output_file}")
+
+
 @click.group()
 def cli():
     """dmosopt-tpu command-line tools."""
@@ -556,6 +691,7 @@ cli.add_command(train)
 cli.add_command(onestep)
 cli.add_command(telemetry)
 cli.add_command(status)
+cli.add_command(fleet)
 
 
 def main():  # console entry point

@@ -337,6 +337,16 @@ class DistOptimizer:
         # JSONL sink for a sweep); only instances created here are
         # closed by `run()`
         self._owns_telemetry = not isinstance(telemetry, Telemetry)
+        # active health tier at driver epoch boundaries (the service
+        # evaluates at step boundaries; docs/observability.md
+        # "Run-health engine"). Only with live telemetry: a
+        # telemetry=False run holds no engine and makes zero health
+        # calls (the zero-object pin).
+        self.health = None
+        if self.telemetry:
+            from dmosopt_tpu.telemetry.health import HealthEngine
+
+            self.health = HealthEngine(telemetry=self.telemetry)
         self.start_time = time.time()
 
         self.logger = logging.getLogger(opt_id)
@@ -973,6 +983,16 @@ class DistOptimizer:
                     self.opt_id, epoch, [s.to_dict() for s in spans],
                     self.file_path, self.logger,
                 )
+        if self.health is not None:
+            alerts = self.health.transitions(epoch=epoch)
+            if alerts:
+                from dmosopt_tpu.storage import save_alerts_to_h5
+
+                self._submit_write(
+                    save_alerts_to_h5,
+                    self.opt_id, epoch, alerts,
+                    self.file_path, self.logger,
+                )
 
     # ------------------------------------------------------------ queries
 
@@ -1484,6 +1504,14 @@ class DistOptimizer:
                 eval_count=self.eval_count,
                 save_count=self.save_count,
             )
+            if self.health is not None:
+                # epoch-boundary health evaluation (no introspect
+                # source on the driver path — rule over the metrics
+                # snapshot only); transitions become health_alert
+                # events and ride into this epoch's persistence below
+                self.health.evaluate(
+                    tel.registry.snapshot(), epoch=epoch, step=epoch
+                )
             self.save_telemetry(epoch)
 
         # exact persistence semantics: every write queued this epoch is

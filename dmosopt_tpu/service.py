@@ -247,12 +247,57 @@ class OptimizationService:
         eval_policy: Union[None, Dict, EvalPolicy] = None,
         default_eval_timeout: float = DEFAULT_EVAL_TIMEOUT,
         checkpoint_path: Optional[str] = None,
+        health_rules=None,
+        exporter=None,
     ):
         self.min_bucket = int(min_bucket)
         self.telemetry = create_telemetry(telemetry)
         self._owns_telemetry = not isinstance(telemetry, Telemetry)
         self.logger = logger
         self.status_path = status_path
+        # active health tier (docs/observability.md "Run-health
+        # engine"): declarative alert rules evaluated over the metrics
+        # snapshot + introspect() at every step boundary, firing ->
+        # resolved lifecycle, surfaced via introspect()["health"], the
+        # status CLI, and /healthz. ``health_rules`` is None (seeded
+        # default rulebook), a rule list, or False (no engine). Only
+        # built with live telemetry: a telemetry=False service holds no
+        # health object and makes zero health calls.
+        self.health = None
+        if self.telemetry and health_rules is not False:
+            from dmosopt_tpu.telemetry.health import HealthEngine
+
+            self.health = HealthEngine(
+                rules=health_rules, telemetry=self.telemetry
+            )
+        # opt-in OpenMetrics exposition (docs/observability.md
+        # "OpenMetrics exposition"): ``exporter`` is None/False (off),
+        # True (ephemeral port on 127.0.0.1), an int port, or a
+        # MetricsExporter kwargs dict. The exporter thread is joined in
+        # close().
+        self.exporter = None
+        if exporter:
+            if self.telemetry is None:
+                raise ValueError(
+                    "exporter requires telemetry (the /metrics surface "
+                    "IS the registry); got telemetry=False"
+                )
+            from dmosopt_tpu.telemetry.exposition import MetricsExporter
+
+            kwargs = (
+                dict(exporter)
+                if isinstance(exporter, dict)
+                else ({} if exporter is True else {"port": int(exporter)})
+            )
+            self.exporter = MetricsExporter(
+                snapshot_fn=self.telemetry.registry.snapshot,
+                health_fn=(
+                    self.health.summary if self.health is not None else None
+                ),
+                status_fn=self.introspect,
+                logger=self.logger,
+                **kwargs,
+            ).start()
         # service-wide fault policy default (per-submit eval_policy
         # overrides it) and the conservative per-attempt timeout used
         # when neither names one — a wedged objective cannot hang a
@@ -939,7 +984,22 @@ class OptimizationService:
                 or per_tenant < self._best_step_s_per_tenant
             ):
                 self._best_step_s_per_tenant = per_tenant
-        self._write_status()
+        snap = None
+        if self.health is not None:
+            # the active tier: rules over (registry snapshot,
+            # introspect snapshot) at this step boundary — transitions
+            # become health_alert events + health_alerts_total counts
+            snap = self.introspect()
+            self.health.evaluate(
+                self.telemetry.registry.snapshot(),
+                snap,
+                step=self._steps_run,
+            )
+            # reuse the snapshot for the status write (introspect is a
+            # full per-tenant walk — once per step, not twice), with
+            # only the health block refreshed to this evaluation
+            snap["health"] = self.health.summary()
+        self._write_status(snap)
 
     # ------------------------------------------------- checkpoint / resume
 
@@ -1298,6 +1358,17 @@ class OptimizationService:
             "last_step": dict(self._last_step),
             "throughput": self._throughput_check(),
         }
+        if self.health is not None:
+            # alert state (docs/observability.md "Run-health engine"):
+            # firing alerts with severities — what /healthz serves and
+            # the status CLI renders as the health block
+            snap["health"] = self.health.summary()
+        if self.exporter is not None:
+            snap["exporter"] = {
+                "host": self.exporter.host,
+                "port": self.exporter.port,
+                "url": self.exporter.url,
+            }
         if self.telemetry and self.telemetry.tracer is not None:
             snap["trace_path"] = self.telemetry.tracer.path
             # span-buffer pressure: evictions past `trace_max_spans` —
@@ -1312,17 +1383,22 @@ class OptimizationService:
             snap["device_ledger"] = ledger.summary()
         return snap
 
-    def _write_status(self):
+    def _write_status(self, snap: Optional[Dict[str, Any]] = None):
         """Atomically publish the introspection snapshot to
         ``status_path`` (tmp + rename, so a concurrent `status` CLI
-        reader never sees a torn file). Best-effort: a failing status
-        write must never take the service down."""
+        reader never sees a torn file). ``snap`` lets `_finish_step`
+        reuse the snapshot it already built for the health evaluation.
+        Best-effort: a failing status write must never take the
+        service down."""
         if self.status_path is None:
             return
         try:
             tmp = self.status_path + ".tmp"
             with open(tmp, "w") as fh:
-                json.dump(self.introspect(), fh, default=json_default)
+                json.dump(
+                    snap if snap is not None else self.introspect(),
+                    fh, default=json_default,
+                )
             os.replace(tmp, self.status_path)
         except OSError:
             self.logger.warning(
@@ -1382,6 +1458,12 @@ class OptimizationService:
                 self._note_writer_dead()
             self._writer = None
         self._write_status()
+        if self.exporter is not None:
+            # after the final status write: the last scrape a prober
+            # can land observes the closed-service snapshot, then the
+            # exporter thread is joined (the PR 11 lifecycle rule)
+            self.exporter.close()
+            self.exporter = None
         if self.telemetry is not None and self._owns_telemetry:
             # exports the Chrome trace when a trace_path is configured
             self.telemetry.close()

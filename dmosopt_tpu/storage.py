@@ -29,6 +29,8 @@ Layout:
     /{opt_id}/{problem_id}/optimizer_params/{epoch}  (json attrs)
     /{opt_id}/{problem_id}/optimizer_stats/{epoch}   (json attrs)
     /{opt_id}/telemetry                              (one json attr per epoch)
+    /{opt_id}/telemetry_spans/{epoch}                (json dataset per epoch)
+    /{opt_id}/telemetry_alerts/{epoch}               (json dataset per epoch)
 """
 
 from __future__ import annotations
@@ -415,6 +417,39 @@ def load_spans_from_h5(fpath, opt_id) -> Dict[int, list]:
     out: Dict[int, list] = {}
     with h5py.File(fpath, "r") as h5:
         grp = h5.get(f"{opt_id}/telemetry_spans")
+        if grp is None:
+            return out
+        for key in grp:
+            raw = grp[key][()]
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            out[int(key)] = json.loads(raw)
+    return dict(sorted(out.items()))
+
+
+def save_alerts_to_h5(opt_id, epoch, alerts, fpath, logger=None):
+    """Append one epoch's health-alert transitions (list of
+    `HealthEngine` transition dicts) under
+    `/{opt_id}/telemetry_alerts/{epoch}` as one JSON string dataset —
+    beside the spans, so a stored run's incident history survives
+    resume. Overwrite-safe when a resumed run re-lands on an epoch."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "a") as h5:
+        grp = h5_get_group(h5, f"{opt_id}/telemetry_alerts")
+        key = str(int(epoch))
+        if key in grp:
+            del grp[key]
+        grp.create_dataset(key, data=json.dumps(alerts, default=json_default))
+
+
+def load_alerts_from_h5(fpath, opt_id) -> Dict[int, list]:
+    """All stored per-epoch health-alert transition lists,
+    `{epoch: [transition dicts]}` (empty when the run predates the
+    health engine or had telemetry disabled)."""
+    h5py = _require_h5py()
+    out: Dict[int, list] = {}
+    with h5py.File(fpath, "r") as h5:
+        grp = h5.get(f"{opt_id}/telemetry_alerts")
         if grp is None:
             return out
         for key in grp:

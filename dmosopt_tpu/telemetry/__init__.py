@@ -29,6 +29,16 @@ from typing import Any, Dict, Optional, Sequence, Union
 
 from dmosopt_tpu.telemetry.device_ledger import DeviceLedger  # noqa: F401
 from dmosopt_tpu.telemetry.events import Event, EventLog, jsonable, read_jsonl  # noqa: F401
+from dmosopt_tpu.telemetry.exposition import (  # noqa: F401
+    MetricsExporter,
+    parse_openmetrics,
+    render_openmetrics,
+)
+from dmosopt_tpu.telemetry.health import (  # noqa: F401
+    HealthEngine,
+    HealthRule,
+    default_rulebook,
+)
 from dmosopt_tpu.telemetry.registry import MetricsRegistry  # noqa: F401
 from dmosopt_tpu.telemetry.tracing import (  # noqa: F401
     Span,

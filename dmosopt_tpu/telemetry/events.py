@@ -193,11 +193,14 @@ class EventLog:
             if self._fh is not None:  # rotation may have gone dark
                 self._fh.write(line)
                 self._bytes += nbytes
-                if kind == "phase":
-                    # a phase close is the natural durability boundary:
+                if kind in ("phase", "health_alert"):
+                    # a phase close is the natural durability boundary
+                    # (and a health-alert transition must never be lost
+                    # to a crash — the alert IS the incident record):
                     # flush so a killed run's sink keeps everything up
-                    # to its last completed phase, independent of the
-                    # file object's buffering mode
+                    # to its last completed phase and every alert fired
+                    # before it, independent of the file object's
+                    # buffering mode
                     self._fh.flush()
         if rotated and self.on_rotate is not None:
             self.on_rotate()

@@ -144,16 +144,26 @@ class MetricsRegistry:
             h.observe(value)
 
     # ------------------------------------------------------------- queries
+    #
+    # Queries hold the same lock as the mutators: a histogram summary
+    # reads five fields of an object another thread may be mid-observe
+    # on, and the exposition layer promises that what `/metrics` serves
+    # agrees EXACTLY with a `snapshot()` taken at the same instant — a
+    # lock-free read could serve a count that includes an observation
+    # whose sum does not (a torn view).
 
     def counter_value(self, name: str, **labels) -> float:
-        return self._counters.get((name, _label_key(labels)), 0.0)
+        with self._lock:
+            return self._counters.get((name, _label_key(labels)), 0.0)
 
     def gauge_value(self, name: str, **labels) -> Optional[float]:
-        return self._gauges.get((name, _label_key(labels)))
+        with self._lock:
+            return self._gauges.get((name, _label_key(labels)))
 
     def histogram_summary(self, name: str, **labels) -> Optional[Dict]:
-        h = self._histograms.get((name, _label_key(labels)))
-        return h.summary() if h is not None else None
+        with self._lock:
+            h = self._histograms.get((name, _label_key(labels)))
+            return h.summary() if h is not None else None
 
     def metric_names(self) -> set:
         with self._lock:
@@ -166,7 +176,14 @@ class MetricsRegistry:
     def snapshot(self) -> Dict:
         """The whole registry as nested plain dicts:
         ``{"counters": {name: {label_str: value}}, "gauges": {...},
-        "histograms": {name: {label_str: summary}}}``."""
+        "histograms": {name: {label_str: summary}}}``.
+
+        The entire snapshot — every counter, gauge, and histogram
+        summary — is built under ONE lock acquisition, so concurrent
+        emission can never produce a torn view: what the OpenMetrics
+        exposition serves is exactly one instant of the registry
+        (pinned by the threaded hammer test in tests/test_telemetry.py).
+        """
         with self._lock:
             out = {"counters": {}, "gauges": {}, "histograms": {}}
             for (name, key), v in self._counters.items():

@@ -1,0 +1,394 @@
+"""OpenMetrics exposition tests: render/parse round trip, exact
+agreement with the registry snapshot, parser red paths, the HTTP
+exporter's three endpoints (including the /healthz critical flip), and
+the exporter thread lifecycle on a live service
+(docs/observability.md "OpenMetrics exposition")."""
+
+import http.client
+import json
+import math
+import threading
+
+import pytest
+
+from dmosopt_tpu.telemetry import MetricsRegistry, Telemetry
+from dmosopt_tpu.telemetry.exposition import (
+    MetricsExporter,
+    OpenMetricsParseError,
+    parse_openmetrics,
+    render_openmetrics,
+    samples_as_snapshot,
+)
+from dmosopt_tpu.telemetry.health import HealthEngine, HealthRule
+
+
+def _populated_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter_inc("evals_total", 12)
+    reg.counter_inc("eval_batches_total", 3, backend="host")
+    reg.counter_inc("eval_batches_total", 9, backend="jax")
+    reg.counter_inc("tenant_cost_seconds", 1.25, tenant="t0", phase="ea")
+    reg.gauge_set("tenants_active", 4)
+    reg.gauge_set("device_memory_bytes_in_use", 1024.0, device="0")
+    for v in (0.002, 0.3, 0.3, 7.0):
+        reg.histogram_observe("phase_duration_seconds", v, phase="train")
+    reg.histogram_observe("eval_wait_seconds", 0.05)
+    return reg
+
+
+# ----------------------------------------------------------- round trip
+
+
+def test_render_parses_as_valid_openmetrics():
+    fams = parse_openmetrics(render_openmetrics(_populated_registry().snapshot()))
+    assert fams["evals"]["type"] == "counter"
+    assert fams["tenants_active"]["type"] == "gauge"
+    assert fams["phase_duration_seconds"]["type"] == "histogram"
+
+
+def test_exposition_agrees_exactly_with_snapshot():
+    """The acceptance pin: what /metrics serves IS the snapshot —
+    every counter and gauge series value round-trips, and histogram
+    count/sum samples match the snapshot summaries."""
+    reg = _populated_registry()
+    snap = reg.snapshot()
+    fams = parse_openmetrics(render_openmetrics(snap))
+    back = samples_as_snapshot(fams)
+    # counters: family name = registry name minus _total
+    for name, series in snap["counters"].items():
+        family = name[:-len("_total")] if name.endswith("_total") else name
+        assert back["counters"][family] == series, name
+    for name, series in snap["gauges"].items():
+        assert back["gauges"][name] == series, name
+    # histograms: count/sum per series
+    for name, series in snap["histograms"].items():
+        samples = {
+            (n, tuple(sorted(lbl.items()))): v
+            for n, lbl, v in fams[name]["samples"]
+        }
+        for label_str, summary in series.items():
+            base = tuple(
+                sorted(
+                    tuple(p.split("=", 1))
+                    for p in label_str.split(",")
+                    if p
+                )
+            )
+            assert samples[(f"{name}_count", base)] == summary["count"]
+            assert samples[(f"{name}_sum", base)] == pytest.approx(
+                summary["sum"]
+            )
+
+
+def test_label_escaping_round_trips():
+    reg = MetricsRegistry()
+    reg.counter_inc("evals_total", 1, note='quo"te\\back\nline')
+    fams = parse_openmetrics(render_openmetrics(reg.snapshot()))
+    (_, labels, value), = fams["evals"]["samples"]
+    assert labels == {"note": 'quo"te\\back\nline'} and value == 1.0
+
+
+def test_histogram_buckets_are_cumulative_with_inf():
+    reg = MetricsRegistry()
+    for v in (0.002, 0.3, 0.3, 7.0):
+        reg.histogram_observe("eval_wait_seconds", v)
+    fams = parse_openmetrics(render_openmetrics(reg.snapshot()))
+    buckets = {
+        labels["le"]: v
+        for n, labels, v in fams["eval_wait_seconds"]["samples"]
+        if n.endswith("_bucket")
+    }
+    assert buckets["+Inf"] == 4.0
+    # cumulative: every finite bucket <= the next one
+    finite = sorted(
+        (float(le), v) for le, v in buckets.items() if le != "+Inf"
+    )
+    assert all(a[1] <= b[1] for a, b in zip(finite, finite[1:]))
+
+
+# ------------------------------------------------------ parser red paths
+
+
+def test_parser_rejects_missing_eof():
+    with pytest.raises(OpenMetricsParseError, match="EOF"):
+        parse_openmetrics("# TYPE x counter\nx_total 1\n")
+
+
+def test_parser_rejects_content_after_eof():
+    with pytest.raises(OpenMetricsParseError, match="after"):
+        parse_openmetrics("# EOF\nx_total 1\n")
+
+
+def test_parser_rejects_counter_without_total_suffix():
+    with pytest.raises(OpenMetricsParseError, match="_total"):
+        parse_openmetrics("# TYPE x counter\nx 1\n# EOF\n")
+
+
+def test_parser_rejects_sample_outside_family():
+    with pytest.raises(OpenMetricsParseError, match="family"):
+        parse_openmetrics("# TYPE x counter\ny_total 1\n# EOF\n")
+
+
+def test_parser_rejects_duplicate_series():
+    text = "# TYPE x counter\nx_total 1\nx_total 2\n# EOF\n"
+    with pytest.raises(OpenMetricsParseError, match="duplicate"):
+        parse_openmetrics(text)
+
+
+def test_parser_rejects_non_cumulative_histogram():
+    text = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\n'
+        'h_bucket{le="2"} 3\n'
+        'h_bucket{le="+Inf"} 5\n'
+        "h_count 5\nh_sum 2.0\n# EOF\n"
+    )
+    with pytest.raises(OpenMetricsParseError, match="cumulative"):
+        parse_openmetrics(text)
+
+
+def test_parser_rejects_inf_bucket_count_mismatch():
+    text = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="+Inf"} 5\n'
+        "h_count 7\nh_sum 2.0\n# EOF\n"
+    )
+    with pytest.raises(OpenMetricsParseError, match="_count"):
+        parse_openmetrics(text)
+
+
+def test_parser_rejects_negative_counter():
+    with pytest.raises(OpenMetricsParseError, match="negative"):
+        parse_openmetrics("# TYPE x counter\nx_total -1\n# EOF\n")
+
+
+# -------------------------------------------------------------- exporter
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def test_exporter_serves_metrics_healthz_statusz():
+    tel = Telemetry()
+    tel.registry.counter_inc("evals_total", 5)
+    eng = HealthEngine(
+        rules=[
+            HealthRule(
+                name="critical_watch", metric="counter:eval_failures_total",
+                threshold=0.0, mode="delta", severity="critical",
+            )
+        ],
+        telemetry=tel,
+    )
+    exporter = MetricsExporter(
+        snapshot_fn=tel.registry.snapshot,
+        health_fn=eng.summary,
+        status_fn=lambda: {"steps": 7, "closed": False},
+    ).start()
+    try:
+        port = exporter.port
+        assert exporter.url == f"http://127.0.0.1:{port}"
+
+        status, body, headers = _get(port, "/metrics")
+        assert status == 200
+        assert "openmetrics-text" in headers["Content-Type"]
+        fams = parse_openmetrics(body)
+        assert fams["evals"]["samples"][0][2] == 5.0
+
+        status, body, _ = _get(port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+
+        # a critical alert flips /healthz non-200 ...
+        tel.registry.counter_inc("eval_failures_total", 3)
+        eng.evaluate(tel.registry.snapshot(), step=1)
+        status, body, _ = _get(port, "/healthz")
+        assert status == 503
+        payload = json.loads(body)
+        assert payload["status"] == "critical"
+        assert payload["firing"][0]["rule"] == "critical_watch"
+
+        # ... and recovers on resolve
+        eng.evaluate(tel.registry.snapshot(), step=2)
+        status, body, _ = _get(port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+
+        status, body, _ = _get(port, "/statusz")
+        assert status == 200 and json.loads(body)["steps"] == 7
+
+        status, _, _ = _get(port, "/nope")
+        assert status == 404
+    finally:
+        exporter.close()
+    assert exporter.port is None
+
+
+def test_exporter_close_joins_thread_and_frees_port():
+    tel = Telemetry()
+    exporter = MetricsExporter(snapshot_fn=tel.registry.snapshot).start()
+    port = exporter.port
+    thread = exporter._thread
+    assert thread.is_alive()
+    exporter.close()
+    assert not thread.is_alive()
+    with pytest.raises(OSError):
+        _get(port, "/metrics")
+    # close is idempotent
+    exporter.close()
+
+
+def test_exporter_broken_snapshot_returns_500_and_survives():
+    calls = {"n": 0}
+
+    def snapshot():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        return {"counters": {}, "gauges": {}, "histograms": {}}
+
+    with MetricsExporter(snapshot_fn=snapshot) as exporter:
+        status, body, _ = _get(exporter.port, "/metrics")
+        assert status == 500 and "boom" in body
+        status, _, _ = _get(exporter.port, "/metrics")
+        assert status == 200  # the thread survived the broken scrape
+
+
+def test_torn_snapshot_never_served_under_concurrent_emission():
+    """Satellite pin: the whole snapshot is one lock acquisition, so a
+    scrape concurrent with emission can never see a histogram whose
+    count disagrees with its buckets, or a counter going backwards."""
+    reg = MetricsRegistry()
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            reg.counter_inc("evals_total", 1)
+            reg.counter_inc("evals_total", 1, backend="host")
+            reg.histogram_observe("eval_wait_seconds", 0.01)
+            reg.gauge_set("tenants_active", 1)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        prev_total = -1.0
+        for _ in range(200):
+            snap = reg.snapshot()
+            for series in snap["histograms"].values():
+                for summary in series.values():
+                    assert summary["count"] == sum(
+                        summary["buckets"].values()
+                    )
+                    assert summary["sum"] == pytest.approx(
+                        0.01 * summary["count"]
+                    )
+            total = sum(
+                snap["counters"].get("evals_total", {}).values()
+            )
+            assert total >= prev_total  # counters never run backwards
+            prev_total = total
+            # the rendered exposition of any snapshot stays valid
+            if total:
+                parse_openmetrics(render_openmetrics(snap))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+# ------------------------------------------------------ service exporter
+
+
+def test_service_exporter_lifecycle_and_introspect():
+    import numpy as np
+
+    from dmosopt_tpu.service import OptimizationService
+
+    def obj(pp):
+        x = np.asarray([pp["x0"], pp["x1"]], dtype=np.float64)
+        return np.asarray([x[0], 1.0 - x[0] + x[1]], dtype=np.float64)
+
+    svc = OptimizationService(telemetry=True, exporter=True)
+    try:
+        info = svc.introspect()["exporter"]
+        assert info["url"].startswith("http://127.0.0.1:")
+        port = info["port"]
+        svc.submit(
+            obj, {"x0": [0.0, 1.0], "x1": [0.0, 1.0]}, ["f1", "f2"],
+            opt_id="exp_t0", jax_objective=False,
+            population_size=8, num_generations=2, n_initial=3, n_epochs=1,
+            surrogate_method_kwargs={"n_starts": 1, "n_iter": 10, "seed": 0},
+            random_seed=1,
+        )
+        svc.step()
+        status, body, _ = _get(port, "/metrics")
+        assert status == 200
+        fams = parse_openmetrics(body)
+        assert fams["service_epochs"]["samples"][0][2] >= 1.0
+        status, body, _ = _get(port, "/statusz")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["steps"] >= 1 and snap["health"]["status"] == "ok"
+        status, _, _ = _get(port, "/healthz")
+        assert status == 200
+    finally:
+        svc.close()
+    assert svc.exporter is None
+    with pytest.raises(OSError):
+        _get(port, "/metrics")
+
+
+def test_service_exporter_requires_telemetry():
+    from dmosopt_tpu.service import OptimizationService
+
+    with pytest.raises(ValueError, match="telemetry"):
+        OptimizationService(telemetry=False, exporter=True)
+
+
+def test_format_value_inf():
+    reg = MetricsRegistry()
+    reg.gauge_set("gp_distill_error", math.inf)
+    fams = parse_openmetrics(render_openmetrics(reg.snapshot()))
+    assert fams["gp_distill_error"]["samples"][0][2] == math.inf
+
+
+def test_user_supplied_label_values_with_commas_and_equals_round_trip():
+    """Review fix: opt_ids are user-supplied and land verbatim in
+    `tenant=` labels — a value containing ',' or '=' must still render
+    and parse back to the original label set."""
+    reg = MetricsRegistry()
+    reg.counter_inc(
+        "tenant_cost_seconds", 2.0, tenant="sweep=lr0.1,bs32", phase="ea"
+    )
+    fams = parse_openmetrics(render_openmetrics(reg.snapshot()))
+    (_, labels, value), = fams["tenant_cost_seconds"]["samples"]
+    assert labels == {"tenant": "sweep=lr0.1,bs32", "phase": "ea"}
+    assert value == 2.0
+
+
+def test_exporter_close_not_blocked_by_idle_keepalive_client():
+    """Review fix: the server is single-threaded and HTTP/1.1
+    keep-alive — an idle client holding its connection open (what
+    Prometheus does between scrapes) must not block close(): the
+    per-connection socket timeout bounds the wait."""
+    import time
+
+    tel = Telemetry()
+    exporter = MetricsExporter(snapshot_fn=tel.registry.snapshot).start()
+    port = exporter.port
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", "/metrics")
+        conn.getresponse().read()
+        # connection stays open (keep-alive); close() must still return
+        t0 = time.monotonic()
+        exporter.close()
+        assert time.monotonic() - t0 < 9.0, "close() blocked on keep-alive"
+    finally:
+        conn.close()

@@ -1,0 +1,296 @@
+"""Fleet telemetry rollup tests: a round trip through the real storage
+API — N stores (multi-run results stores + a service checkpoint) are
+written with known telemetry/span/alert/refit content, scanned, and
+rolled up into per-signature distributions that must reproduce each
+run's per-problem summaries and match hand-computed hyperparameter
+statistics (docs/observability.md "Fleet telemetry rollup")."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from dmosopt_tpu.datatypes import ParameterSpace  # noqa: E402
+from dmosopt_tpu.storage import (  # noqa: E402
+    init_h5,
+    save_alerts_to_h5,
+    save_front_to_h5,
+    save_refit_state_to_h5,
+    save_service_checkpoint_to_h5,
+    save_spans_to_h5,
+    save_telemetry_to_h5,
+)
+from dmosopt_tpu.telemetry.fleet import (  # noqa: E402
+    fleet_summary,
+    problem_signature,
+    rollup,
+    scan_store,
+    write_fleet_summary,
+)
+
+
+def _space(dim):
+    return ParameterSpace.from_dict(
+        {f"x{i}": [0.0, 1.0] for i in range(dim)}
+    )
+
+
+def _write_run(
+    path, opt_id, dim, *, amp, ls, noise, n_train, epochs, fronts=(),
+    alerts=None,
+):
+    space = _space(dim)
+    init_h5(
+        opt_id, [0], False, space, space.parameter_names, ["f1", "f2"],
+        None, None, None, {"kind": "fleet-test"}, 42, path,
+    )
+    for e in range(epochs):
+        save_telemetry_to_h5(
+            opt_id, e,
+            {
+                "epoch": e, "wall_s": 2.0 + e,
+                "phases": {"train": 1.0, "optimize": 0.5},
+                "n_generations": 10, "gens_per_sec": 20.0,
+                "fit_n_steps": 30, "n_train": 8 * (e + 1),
+                "eval": {"eval_n": 4, "eval_sum": 0.4},
+            },
+            path,
+        )
+    save_spans_to_h5(
+        opt_id, 0,
+        [
+            {"name": "gp_fit", "duration_s": 0.5},
+            {"name": "ea_scan", "duration_s": 0.25},
+            {"name": "gp_fit", "duration_s": 0.75},
+        ],
+        path,
+    )
+    for a in alerts or []:
+        save_alerts_to_h5(opt_id, a.pop("epoch"), [a], path)
+    save_refit_state_to_h5(
+        opt_id, 0,
+        {
+            "amp": amp, "ls": ls, "noise": noise,
+            "eff_noise": noise, "n_train": n_train,
+            "stable": 1, "warm_wins": 2, "fits_since_audit": 0,
+            "n_iter_max": 100,
+        },
+        path,
+    )
+    for e in fronts:
+        save_front_to_h5(
+            opt_id, e, space.parameter_names, ["f1", "f2"],
+            np.zeros((3, dim)), np.zeros((3, 2)), path,
+        )
+
+
+def _write_checkpoint(path, opt_id, dim, *, amp, ls, noise, n_train):
+    payload = {
+        "service": {"ts": 0.0, "steps": 4, "min_bucket": 2},
+        "tenants": {
+            "0": {
+                "config": {
+                    "space": {f"x{i}": [0.0, 1.0] for i in range(dim)},
+                    "objective_names": ["f1", "f2"],
+                    "n_epochs": 5,
+                },
+                "state": {
+                    "opt_id": opt_id, "tenant_id": 0, "epochs_run": 3,
+                    "n_epochs": 5, "epoch_index": 2, "optimizer_draws": 3,
+                    "rng_state": {}, "quarantined": 2, "eval_failures": 1,
+                    "refit": {
+                        "amp": amp, "ls": ls, "noise": noise,
+                        "n_train": n_train,
+                    },
+                },
+                "arrays": {"x": np.zeros((4, dim))},
+            }
+        },
+    }
+    save_service_checkpoint_to_h5(payload, path)
+
+
+def test_fleet_round_trip_over_two_stores(tmp_path):
+    a = str(tmp_path / "run_a.h5")
+    b = str(tmp_path / "run_b.h5")
+    ckpt = str(tmp_path / "svc.h5")
+
+    _write_run(
+        a, "run_a", 4, amp=[1.0, 2.0], ls=[[0.5, 0.5, 1.0, 1.0]] * 2,
+        noise=[0.01, 0.02], n_train=24, epochs=2, fronts=(1, 2),
+        alerts=[
+            {"epoch": 1, "rule": "quarantine_spike", "severity": "warning",
+             "state": "firing", "value": 2.0, "threshold": 0.0, "step": 1},
+        ],
+    )
+    # a second opt_id of a DIFFERENT signature in the same store
+    _write_run(
+        a, "run_c", 3, amp=[4.0], ls=[[2.0, 2.0, 2.0]], noise=[0.1],
+        n_train=12, epochs=1,
+    )
+    _write_run(
+        b, "run_b", 4, amp=[3.0, 4.0], ls=[[1.5, 1.5, 2.0, 2.0]] * 2,
+        noise=[0.03, 0.04], n_train=40, epochs=3,
+    )
+    _write_checkpoint(
+        ckpt, "tenant_x", 4, amp=[5.0, 6.0], ls=[[3.0, 3.0, 4.0, 4.0]] * 2,
+        noise=[0.05, 0.06], n_train=16,
+    )
+
+    summary = fleet_summary([a, b, ckpt])
+    assert summary["format"] == "dmosopt_tpu.fleet_summary"
+    assert summary["n_stores"] == 3 and summary["n_runs"] == 4
+
+    runs = {r["opt_id"]: r for r in summary["runs"]}
+    assert set(runs) == {"run_a", "run_b", "run_c", "tenant_x"}
+
+    # --- per-run records reproduce each run's per-problem summaries
+    ra = runs["run_a"]
+    assert ra["signature"] == "d4_o2" == problem_signature(4, 2)
+    assert ra["telemetry"]["epochs"] == 2
+    assert ra["telemetry"]["wall_s_total"] == pytest.approx(2.0 + 3.0)
+    assert ra["telemetry"]["gens_total"] == 20
+    assert ra["telemetry"]["fit_steps_total"] == 60
+    assert ra["telemetry"]["evals_total"] == 8
+    assert ra["telemetry"]["gens_per_sec_mean"] == pytest.approx(20.0)
+    assert ra["spans"] == {
+        "gp_fit": {"count": 2, "seconds": 1.25},
+        "ea_scan": {"count": 1, "seconds": 0.25},
+    }
+    assert ra["alerts"] == {"quarantine_spike": 1}
+    assert ra["refit"]["0"]["amp"] == [1.0, 2.0]
+    assert ra["fronts"] == {
+        "n_epochs": 2, "first_epoch": 1, "last_epoch": 2,
+    }
+    assert ra["epochs_to_front"] == 2
+
+    rb = runs["run_b"]
+    assert rb["telemetry"]["epochs"] == 3
+    assert rb["telemetry"]["fit_steps_total"] == 90
+
+    rx = runs["tenant_x"]
+    assert rx["kind"] == "service_checkpoint"
+    assert rx["signature"] == "d4_o2"
+    assert rx["telemetry"]["epochs"] == 3
+    assert rx["quarantined_total"] == 2
+    # review fix: the checkpoint's archive rows + quarantined rows are
+    # the evaluation denominator, so quarantine_rate is a true rate
+    assert rx["telemetry"]["evals_total"] == 4 + 2
+    assert rx["refit"]["0"]["noise"] == [0.05, 0.06]
+
+    rc = runs["run_c"]
+    assert rc["signature"] == "d3_o2"
+
+    # --- per-signature hyperparameter distributions, hand-computed
+    sig = summary["signatures"]["d4_o2"]
+    assert sig["n_runs"] == 3
+    amps = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+    amp_dist = sig["hyperparameters"]["amp"]["linear"]
+    assert amp_dist["count"] == 6
+    assert amp_dist["mean"] == pytest.approx(np.mean(amps))
+    assert amp_dist["median"] == pytest.approx(np.median(amps))
+    assert amp_dist["min"] == 1.0 and amp_dist["max"] == 6.0
+    amp_log = sig["hyperparameters"]["amp"]["log10"]
+    assert amp_log["mean"] == pytest.approx(
+        np.mean([math.log10(v) for v in amps])
+    )
+    ls_dist = sig["hyperparameters"]["lengthscale"]["linear"]
+    assert ls_dist["count"] == 3 * 8  # three runs x (2 obj x 4 dims)
+    noise_dist = sig["hyperparameters"]["noise"]["linear"]
+    assert noise_dist["min"] == pytest.approx(0.01)
+    assert noise_dist["max"] == pytest.approx(0.06)
+    assert sig["n_train"]["count"] == 3
+    assert sig["n_train"]["max"] == 40.0
+    assert sig["epochs"]["mean"] == pytest.approx((2 + 3 + 3) / 3)
+    assert sig["epochs_to_front"]["mean"] == pytest.approx(2.0)
+    assert sig["alert_firings"] == {"quarantine_spike": 1}
+    assert sig["quarantine_rate"]["mean"] == pytest.approx(2.0 / 6.0)
+    assert sig["quarantine_rate"]["count"] == 1
+
+    other = summary["signatures"]["d3_o2"]
+    assert other["n_runs"] == 1
+    assert other["hyperparameters"]["amp"]["linear"]["mean"] == 4.0
+
+    # --- the written JSON round-trips byte-for-byte as JSON
+    out = str(tmp_path / "fleet.json")
+    written = write_fleet_summary([a, b, ckpt], out)
+    with open(out) as fh:
+        loaded = json.load(fh)
+    assert loaded == json.loads(
+        json.dumps(written, default=lambda o: o)
+    )
+    assert loaded["signatures"]["d4_o2"]["hyperparameters"]["amp"][
+        "linear"
+    ]["count"] == 6
+
+
+def test_scan_store_tolerates_runs_without_telemetry(tmp_path):
+    path = str(tmp_path / "bare.h5")
+    space = _space(2)
+    init_h5(
+        "bare", [0], False, space, space.parameter_names, ["f1", "f2"],
+        None, None, None, None, 1, path,
+    )
+    records = scan_store(path)
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["telemetry"]["epochs"] == 0
+    assert rec["spans"] == {} and rec["alerts"] == {} and rec["refit"] == {}
+    # rolls up without error; no hyperparameter data -> None dists
+    summary = rollup(records)
+    hp = summary["signatures"]["d2_o2"]["hyperparameters"]
+    assert hp["amp"]["linear"] is None and hp["amp"]["log10"] is None
+
+
+def test_fleet_summary_missing_store_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        fleet_summary([str(tmp_path / "nope.h5")])
+
+
+def test_fleet_cli_table_and_json(tmp_path):
+    click = pytest.importorskip("click")  # noqa: F841
+    from click.testing import CliRunner
+
+    from dmosopt_tpu.cli import fleet as fleet_cmd
+
+    a = str(tmp_path / "a.h5")
+    b = str(tmp_path / "b.h5")
+    _write_run(
+        a, "cli_a", 4, amp=[1.0], ls=[[1.0] * 4], noise=[0.01],
+        n_train=10, epochs=2,
+    )
+    _write_run(
+        b, "cli_b", 4, amp=[2.0], ls=[[2.0] * 4], noise=[0.02],
+        n_train=20, epochs=2,
+    )
+    out = str(tmp_path / "fleet.json")
+    result = CliRunner().invoke(
+        fleet_cmd, ["-p", a, "-p", b, "-o", out]
+    )
+    assert result.exit_code == 0, result.output
+    assert "2 run(s) across 2 store(s)" in result.output
+    assert "signature d4_o2" in result.output
+    assert "lengthscale" in result.output
+    with open(out) as fh:
+        data = json.load(fh)
+    assert data["signatures"]["d4_o2"]["n_runs"] == 2
+
+    as_json = CliRunner().invoke(
+        fleet_cmd, ["-p", a, "-p", b, "--as-json"]
+    )
+    assert as_json.exit_code == 0
+    assert json.loads(as_json.output)["n_runs"] == 2
+
+    bad_sig = CliRunner().invoke(
+        fleet_cmd, ["-p", a, "-s", "d9_o9"]
+    )
+    assert bad_sig.exit_code != 0
+    assert "d9_o9" in bad_sig.output
+
+    filtered = CliRunner().invoke(
+        fleet_cmd, ["-p", a, "-p", b, "-s", "d4_o2"]
+    )
+    assert filtered.exit_code == 0, filtered.output

@@ -654,6 +654,54 @@ def test_metrics_catalog_scans_span_names(tmp_path):
     assert all("span" in f.message for f in live)
 
 
+def test_metrics_catalog_scans_health_rules(tmp_path):
+    """ISSUE 14 extension (red-path fixture): a `HealthRule(...)` whose
+    metric expression references a counter/gauge absent from the
+    catalog turns lint red; cataloged references and `introspect:`
+    paths (not registry metrics) stay green."""
+    files = {
+        "docs/observability.md": (
+            "Catalog: `documented_total` and `documented_gauge`.\n"
+        ),
+        "dmosopt_tpu/rules.py": """
+            from dmosopt_tpu.telemetry.health import HealthRule
+
+            RULES = [
+                HealthRule(
+                    name="green_counter",
+                    metric="counter:documented_total",
+                    threshold=1.0,
+                ),
+                HealthRule("green_gauge", "gauge:documented_gauge", 0.5),
+                HealthRule(
+                    name="red_rule",
+                    metric="counter:phantom_metric_total",
+                    threshold=1.0,
+                ),
+                HealthRule("red_positional", "gauge:phantom_gauge", 2.0),
+                HealthRule(
+                    name="introspect_exempt",
+                    metric="introspect:writer.failed",
+                    threshold=1.0,
+                ),
+            ]
+        """,
+    }
+    findings = _lint(
+        tmp_path, files, rules=["metrics-catalog"], targets=("dmosopt_tpu",)
+    )
+    live = _live(findings, "metrics-catalog")
+    assert len(live) == 2, [f.message for f in live]
+    flagged = {
+        name
+        for f in live
+        for name in ("phantom_metric_total", "phantom_gauge")
+        if name in f.message
+    }
+    assert flagged == {"phantom_metric_total", "phantom_gauge"}
+    assert all("health rule" in f.message for f in live)
+
+
 # ------------------------------------------------- suppression hygiene
 
 

@@ -1,0 +1,468 @@
+"""Run-health engine tests: rule validation, the deterministic
+firing -> resolved lifecycle, the seeded rulebook's pinned alert set
+under a chaos plan (the fast-suite arm of `make health-smoke`), alert
+crash-tail durability, HDF5 alert persistence, and the zero-object
+pins (docs/observability.md "Run-health engine")."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dmosopt_tpu.telemetry import Telemetry, read_jsonl
+from dmosopt_tpu.telemetry.health import (
+    HealthEngine,
+    HealthRule,
+    default_rulebook,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- rules
+
+
+def test_health_rule_validation():
+    HealthRule(name="ok_rule", metric="counter:evals_total", threshold=1.0)
+    with pytest.raises(ValueError):
+        HealthRule(name="BadName", metric="counter:evals_total", threshold=1)
+    with pytest.raises(ValueError):
+        HealthRule(name="bad_expr", metric="evals_total", threshold=1)
+    with pytest.raises(ValueError):
+        HealthRule(
+            name="bad_sev", metric="counter:evals_total", threshold=1,
+            severity="fatal",
+        )
+    with pytest.raises(ValueError):
+        HealthRule(
+            name="bad_cmp", metric="counter:evals_total", threshold=1,
+            compare="!=",
+        )
+    with pytest.raises(ValueError):
+        HealthRule(
+            name="bad_mode", metric="counter:evals_total", threshold=1,
+            mode="rate",
+        )
+    with pytest.raises(ValueError):
+        HealthRule(
+            name="bad_for", metric="counter:evals_total", threshold=1,
+            for_steps=0,
+        )
+    # round-trips through the dict spec
+    r = HealthRule(
+        name="rt", metric="gauge:tenants_active", threshold=3.0,
+        compare="<", for_steps=2, mode="value", severity="critical",
+    )
+    assert HealthRule.from_spec(r.to_dict()) == r
+
+
+def test_engine_rejects_duplicate_rule_names():
+    rules = [
+        HealthRule(name="dup", metric="counter:evals_total", threshold=1),
+        HealthRule(name="dup", metric="counter:epochs_total", threshold=1),
+    ]
+    with pytest.raises(ValueError, match="duplicate"):
+        HealthEngine(rules=rules)
+
+
+# -------------------------------------------------------------- lifecycle
+
+
+def _snapshot(counters=None, gauges=None):
+    return {
+        "counters": {
+            k: {"": float(v)} for k, v in (counters or {}).items()
+        },
+        "gauges": {k: {"": float(v)} for k, v in (gauges or {}).items()},
+        "histograms": {},
+    }
+
+
+def test_value_rule_with_hysteresis_fires_and_resolves():
+    eng = HealthEngine(rules=[
+        HealthRule(
+            name="low_gauge", metric="gauge:tenants_active",
+            threshold=2.0, compare="<", for_steps=2,
+        ),
+    ])
+    # one breaching round is NOT enough (for_steps=2)
+    assert eng.evaluate(_snapshot(gauges={"tenants_active": 1}), step=1) == []
+    tr = eng.evaluate(_snapshot(gauges={"tenants_active": 1}), step=2)
+    assert [t["state"] for t in tr] == ["firing"]
+    assert eng.active()[0]["rule"] == "low_gauge"
+    assert eng.summary()["status"] == "alerting"
+    # recovery resolves immediately and clears the streak
+    tr = eng.evaluate(_snapshot(gauges={"tenants_active": 5}), step=3)
+    assert [t["state"] for t in tr] == ["resolved"]
+    assert eng.active() == [] and eng.summary()["status"] == "ok"
+    # one breach again: streak restarted from zero
+    assert eng.evaluate(_snapshot(gauges={"tenants_active": 0}), step=4) == []
+
+
+def test_delta_rule_baselines_at_zero_and_tracks_increments():
+    eng = HealthEngine(rules=[
+        HealthRule(
+            name="timeout_surge", metric="counter:eval_timeouts_total",
+            threshold=2.0, mode="delta",
+        ),
+    ])
+    # counters are implicitly zero before first emission: a first
+    # sighting of 3 is a delta of 3 (the spike must not hide behind a
+    # first-observation baseline)
+    tr = eng.evaluate(_snapshot(counters={"eval_timeouts_total": 3}), step=1)
+    assert [t["state"] for t in tr] == ["firing"] and tr[0]["value"] == 3.0
+    # unchanged counter -> delta 0 -> resolve
+    tr = eng.evaluate(_snapshot(counters={"eval_timeouts_total": 3}), step=2)
+    assert [t["state"] for t in tr] == ["resolved"]
+    # +2 is under the >2 threshold
+    assert eng.evaluate(
+        _snapshot(counters={"eval_timeouts_total": 5}), step=3
+    ) == []
+
+
+def test_missing_gauge_and_introspect_paths_skip_the_rule():
+    eng = HealthEngine(rules=[
+        HealthRule(
+            name="busy_collapse", metric="gauge:device_busy_fraction",
+            threshold=0.1, compare="<", for_steps=1,
+        ),
+        HealthRule(
+            name="backlog", metric="introspect:queue_depths.writer_backlog",
+            threshold=10.0,
+        ),
+    ])
+    # neither source can answer: no transitions, state frozen
+    assert eng.evaluate(_snapshot(), introspect={}, step=1) == []
+    assert eng.summary()["status"] == "ok"
+    # gauge appears below threshold -> fires; introspect path appears
+    tr = eng.evaluate(
+        _snapshot(gauges={"device_busy_fraction": 0.05}),
+        introspect={"queue_depths": {"writer_backlog": 99}},
+        step=2,
+    )
+    assert sorted(t["rule"] for t in tr) == ["backlog", "busy_collapse"]
+
+
+def test_critical_alert_and_bool_introspect_leaf():
+    eng = HealthEngine(rules=[
+        HealthRule(
+            name="writer_dead", metric="introspect:writer.failed",
+            threshold=1.0, compare=">=", severity="critical",
+        ),
+    ])
+    assert not eng.has_critical()
+    tr = eng.evaluate(
+        _snapshot(), introspect={"writer": {"failed": True}}, step=1
+    )
+    assert tr[0]["severity"] == "critical"
+    assert eng.has_critical()
+    assert eng.summary()["status"] == "critical"
+    eng.evaluate(_snapshot(), introspect={"writer": {"failed": False}}, step=2)
+    assert not eng.has_critical()
+
+
+def test_engine_emits_events_and_counters_through_telemetry():
+    tel = Telemetry()
+    eng = HealthEngine(
+        rules=[
+            HealthRule(
+                name="epoch_watch", metric="counter:epochs_total",
+                threshold=0.0, mode="delta",
+            )
+        ],
+        telemetry=tel,
+    )
+    tel.registry.counter_inc("epochs_total")
+    eng.evaluate(tel.registry.snapshot(), step=0, epoch=4)
+    events = tel.log.records(kind="health_alert")
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.epoch == 4
+    assert ev.fields["rule"] == "epoch_watch"
+    assert ev.fields["state"] == "firing"
+    assert tel.registry.counter_value(
+        "health_alerts_total", rule="epoch_watch", severity="warning"
+    ) == 1.0
+    # resolved transitions are events only, never counted
+    eng.evaluate(tel.registry.snapshot(), step=1, epoch=5)
+    assert tel.registry.counter_value(
+        "health_alerts_total", rule="epoch_watch", severity="warning"
+    ) == 1.0
+    assert len(tel.log.records(kind="health_alert")) == 2
+    json.dumps([e.to_dict() for e in tel.log.records(kind="health_alert")])
+
+
+def test_default_rulebook_is_valid_and_deduplicated():
+    rules = default_rulebook()
+    names = [r.name for r in rules]
+    assert len(names) == len(set(names))
+    assert "writer_dead" in names and "host_contention" in names
+    det = default_rulebook(include_host=False)
+    assert "host_contention" not in [r.name for r in det]
+    # every rule constructs an engine cleanly
+    HealthEngine(rules=rules)
+
+
+def test_determinism_same_snapshots_same_transitions():
+    snaps = [
+        _snapshot(counters={"eval_timeouts_total": v})
+        for v in (0, 4, 4, 9, 9)
+    ]
+
+    def run():
+        eng = HealthEngine(rules=default_rulebook(include_host=False))
+        out = []
+        for i, s in enumerate(snaps):
+            out.extend(eng.evaluate(s, step=i))
+        return [(t["rule"], t["state"], t["value"], t["step"]) for t in out]
+
+    assert run() == run() != []
+
+
+# ------------------------------------------------- chaos-plan pinned set
+
+SMK = {"n_starts": 2, "n_iter": 20, "seed": 0}
+POLICY = {
+    "timeout": 0.15,
+    "retries": 0,
+    "on_eval_failure": "quorum",
+    "min_success_fraction": 0.5,
+    "max_failed_epochs": 2,
+}
+FAULT_PLAN = {
+    "seed": 11,
+    "rules": [
+        {"kind": "hang", "target": "h_hang", "delay_s": 0.6},
+        {"kind": "nan", "target": "h_nan", "p": 1.0},
+    ],
+}
+EXPECTED_ALERTS = [
+    ("eval_failure_surge", "warning"),
+    ("eval_timeout_surge", "warning"),
+    ("tenant_quarantine_spike", "warning"),
+]
+
+
+def _host_zdt1(dim):
+    def f(pp):
+        x = np.asarray([pp[f"x{i}"] for i in range(dim)], dtype=np.float64)
+        f1 = x[0]
+        g = 1.0 + 9.0 * np.mean(x[1:])
+        f2 = g * (1.0 - np.sqrt(f1 / g))
+        return np.asarray([f1, f2], dtype=np.float64)
+
+    return f
+
+
+def _run_health_service():
+    from dmosopt_tpu.service import OptimizationService
+
+    svc = OptimizationService(
+        min_bucket=2, telemetry=True, eval_policy=dict(POLICY),
+        health_rules=default_rulebook(include_host=False),
+    )
+
+    def submit(name, seed, n_epochs, policy=None):
+        svc.submit(
+            _host_zdt1(3),
+            {f"x{i}": [0.0, 1.0] for i in range(3)},
+            ["f1", "f2"],
+            opt_id=name, jax_objective=False,
+            population_size=16, num_generations=4, n_initial=3,
+            n_epochs=n_epochs, surrogate_method_kwargs=dict(SMK),
+            random_seed=seed, eval_policy=policy,
+        )
+
+    submit("h_ok", 21, 3)
+    submit("h_hang", 22, 2)
+    submit("h_nan", 23, 2, policy=dict(POLICY, on_eval_failure="skip"))
+    svc.run()
+    fired = svc.health.fired()
+    active = svc.health.active()
+    snap = svc.introspect()
+    reg = svc.telemetry.registry
+    counts = {
+        (r, s): reg.counter_value("health_alerts_total", rule=r, severity=s)
+        for r, s in EXPECTED_ALERTS
+    }
+    svc.close()
+    return fired, active, snap, counts
+
+
+def test_seeded_chaos_plan_fires_exact_alert_set(monkeypatch):
+    """The ISSUE 14 determinism pin (mirrors `make health-smoke`): the
+    seeded fault plan fires EXACTLY the expected (rule, severity) set,
+    every firing is counted, the alerts surface in introspect()['health'],
+    and all of them resolve once the faulty tenants are retired."""
+    monkeypatch.setenv("DMOSOPT_FAULT_PLAN", json.dumps(FAULT_PLAN))
+    fired, active, snap, counts = _run_health_service()
+    assert fired == EXPECTED_ALERTS
+    assert all(v >= 1 for v in counts.values()), counts
+    assert active == [], "alerts must resolve after the faulty retire"
+    health = snap["health"]
+    assert health["status"] == "ok"
+    # firing + resolved for each alert
+    assert health["transitions_total"] >= 2 * len(EXPECTED_ALERTS)
+
+
+def test_fault_free_run_fires_no_alerts(monkeypatch):
+    monkeypatch.delenv("DMOSOPT_FAULT_PLAN", raising=False)
+    fired, active, snap, counts = _run_health_service()
+    assert fired == [] and active == []
+    assert snap["health"]["status"] == "ok"
+    assert snap["health"]["transitions_total"] == 0
+    assert all(v == 0 for v in counts.values())
+
+
+# ------------------------------------------------------- crash durability
+
+
+def test_alert_crash_tail_survives_kill(tmp_path):
+    """Satellite: every alert fired before the last completed phase
+    survives in the JSONL sink when the process dies via os._exit(9) —
+    the sink flushes on health_alert transitions exactly like phase
+    closes (the PR 10 crash-tail discipline extended to alerts)."""
+    sink = tmp_path / "alerts.jsonl"
+    script = f"""
+import os
+from dmosopt_tpu.telemetry import Telemetry
+from dmosopt_tpu.telemetry.health import HealthEngine, HealthRule
+
+tel = Telemetry(jsonl_path={str(sink)!r})
+eng = HealthEngine(
+    rules=[HealthRule(name="crash_watch", metric="counter:evals_total",
+                      threshold=0.0, mode="delta")],
+    telemetry=tel,
+)
+tel.registry.counter_inc("evals_total", 5)
+eng.evaluate(tel.registry.snapshot(), step=0, epoch=0)
+tel.event("phase", epoch=0, phase="train", duration_s=0.5)
+os._exit(9)  # killed: no close(), no interpreter shutdown
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (env.get("PYTHONPATH"), REPO) if p
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 9, proc.stderr
+    events = list(read_jsonl(str(sink)))
+    kinds = [e.kind for e in events]
+    assert kinds == ["health_alert", "phase"]
+    assert events[0].fields["rule"] == "crash_watch"
+    assert events[0].fields["state"] == "firing"
+
+
+# ------------------------------------------------------ HDF5 persistence
+
+
+def test_alerts_h5_round_trip(tmp_path):
+    h5py = pytest.importorskip("h5py")  # noqa: F841
+    from dmosopt_tpu.storage import load_alerts_from_h5, save_alerts_to_h5
+
+    path = str(tmp_path / "alerts.h5")
+    t0 = [
+        {"rule": "quarantine_spike", "severity": "warning",
+         "state": "firing", "value": 3.0, "threshold": 0.0, "step": 0},
+    ]
+    t1 = [
+        {"rule": "quarantine_spike", "severity": "warning",
+         "state": "resolved", "value": 0.0, "threshold": 0.0, "step": 1},
+    ]
+    save_alerts_to_h5("run", 0, t0, path)
+    save_alerts_to_h5("run", 1, t1, path)
+    out = load_alerts_from_h5(path, "run")
+    assert out == {0: t0, 1: t1}
+    # overwrite-safe on a resumed epoch
+    save_alerts_to_h5("run", 1, t0, path)
+    assert load_alerts_from_h5(path, "run")[1] == t0
+    assert load_alerts_from_h5(path, "other") == {}
+
+
+# ------------------------------------------------------ zero-object pins
+
+
+def test_service_without_telemetry_holds_no_health_engine():
+    from dmosopt_tpu.service import OptimizationService
+
+    svc = OptimizationService(telemetry=False)
+    assert svc.telemetry is None and svc.health is None
+    assert "health" not in svc.introspect()
+    svc.close()
+
+
+def test_service_health_rules_false_disables_engine():
+    from dmosopt_tpu.service import OptimizationService
+
+    svc = OptimizationService(telemetry=True, health_rules=False)
+    assert svc.telemetry is not None and svc.health is None
+    svc.close()
+
+
+# ------------------------------------------------------ driver wiring
+
+
+def test_driver_epoch_boundary_alerts_persist_to_h5(tmp_path):
+    """Driver arm of the tentpole: a NaN-poisoned objective quarantines
+    rows, the epoch-boundary health evaluation fires `quarantine_spike`
+    (delta of `points_quarantined_total`), and the transitions land in
+    the HDF5 `telemetry_alerts` group beside the spans."""
+    import dmosopt_tpu
+    from dmosopt_tpu.storage import load_alerts_from_h5
+
+    n_dim = 5
+
+    def nan_obj(pp):
+        x = np.array([pp[f"x{i}"] for i in range(n_dim)])
+        if x[0] > 0.5:
+            return np.array([np.nan, np.nan])
+        f1 = x[0]
+        g = 1.0 + 9.0 / (n_dim - 1) * np.sum(x[1:])
+        return np.array([f1, g * (1.0 - np.sqrt(f1 / g))])
+
+    fp = str(tmp_path / "nan_run.h5")
+    dmosopt_tpu.run(
+        {
+            "opt_id": "health_run",
+            "obj_fun": nan_obj,
+            "objective_names": ["f1", "f2"],
+            "space": {f"x{i}": [0.0, 1.0] for i in range(n_dim)},
+            "problem_parameters": {},
+            "n_initial": 8,
+            "n_epochs": 2,
+            "population_size": 24,
+            "num_generations": 8,
+            "resample_fraction": 0.5,
+            "surrogate_method_name": "gpr",
+            "surrogate_method_kwargs": {
+                "n_starts": 2, "n_iter": 20, "seed": 0,
+            },
+            "random_seed": 11,
+            "save": True,
+            "file_path": fp,
+        },
+        verbose=False,
+    )
+    from dmosopt_tpu.dmosopt import dopt_dict
+
+    dopt = dopt_dict["health_run"]
+    assert dopt.health is not None
+    fired = dopt.health.fired()
+    assert ("quarantine_spike", "warning") in fired
+    alerts = load_alerts_from_h5(fp, "health_run")
+    assert alerts, "alert transitions must persist beside the spans"
+    flat = [a for evs in alerts.values() for a in evs]
+    assert any(
+        a["rule"] == "quarantine_spike" and a["state"] == "firing"
+        for a in flat
+    )
+    # counted under the cataloged counter with rule/severity labels
+    assert dopt.telemetry.registry.counter_value(
+        "health_alerts_total", rule="quarantine_spike", severity="warning"
+    ) >= 1.0

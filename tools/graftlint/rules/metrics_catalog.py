@@ -12,6 +12,14 @@ contract: span names opened via ``.span(`` / ``.record_span(`` (the
 "name")`` helper must appear in the catalog's span taxonomy, so an
 undocumented span turns ``make lint`` red exactly like an uncataloged
 metric.
+
+ISSUE 14 extends the same contract to the **health rulebook**
+(`dmosopt_tpu.telemetry.health`): every ``HealthRule(...)``
+construction whose metric expression references a registry metric
+(``counter:<name>`` / ``gauge:<name>``) must reference a cataloged
+name — an alert definition cannot rot ahead of the catalog
+(``introspect:`` expressions read the introspection snapshot, not the
+registry, and are exempt).
 """
 
 from __future__ import annotations
@@ -32,7 +40,13 @@ SPAN_METHODS = ("span", "record_span")
 #: span-opening helper functions: name is the SECOND argument
 #: (the first is the telemetry object)
 SPAN_HELPERS = ("span_scope",)
+#: health-rule constructors: the `metric` expression (2nd positional
+#: arg or `metric=` keyword) may reference registry metrics
+HEALTH_RULE_CTORS = ("HealthRule",)
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+#: registry-referencing health expressions (introspect: paths are not
+#: registry metrics and are exempt from the catalog)
+_HEALTH_EXPR_RE = re.compile(r"^(?:counter|gauge):([a-z][a-z0-9_]*)$")
 CATALOG_RELPATH = Path("docs") / "observability.md"
 
 
@@ -86,6 +100,39 @@ def spans_in_tree(tree: ast.AST):
                 yield name, node
 
 
+def health_rule_metrics_in_tree(tree: ast.AST):
+    """Yield ``(metric_name, node)`` for every registry metric a
+    ``HealthRule(...)`` construction references: the ``metric``
+    expression (keyword, or the second positional argument after
+    ``name``) parsed for a ``counter:``/``gauge:`` prefix. String
+    literals only — same scanability contract as emissions."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        ctor = (
+            func.id
+            if isinstance(func, ast.Name)
+            else (func.attr if isinstance(func, ast.Attribute) else None)
+        )
+        if ctor not in HEALTH_RULE_CTORS:
+            continue
+        expr = None
+        for kw in node.keywords:
+            if kw.arg == "metric" and isinstance(kw.value, ast.Constant):
+                if isinstance(kw.value.value, str):
+                    expr = kw.value.value
+        if expr is None and len(node.args) > 1:
+            arg = node.args[1]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                expr = arg.value
+        if expr is None:
+            continue
+        m = _HEALTH_EXPR_RE.match(expr)
+        if m is not None:
+            yield m.group(1), node
+
+
 def catalog_names(doc_path: Path) -> set:
     """Every backticked snake_case token in the catalog doc."""
     return set(re.findall(r"`([a-z][a-z0-9_]*)`", Path(doc_path).read_text()))
@@ -123,8 +170,9 @@ def check(package_root: Path, doc_path: Path) -> list:
 class MetricsCatalogRule(Rule):
     name = "metrics-catalog"
     description = (
-        "every telemetry metric name emitted and span name opened in "
-        "the package is backticked in docs/observability.md"
+        "every telemetry metric name emitted, span name opened, and "
+        "health-rule metric reference in the package is backticked in "
+        "docs/observability.md"
     )
     incident = (
         "PR 1 observability contract: an uncataloged metric is invisible "
@@ -157,5 +205,15 @@ class MetricsCatalogRule(Rule):
                         f"cataloged in {CATALOG_RELPATH} — add it to "
                         f"the span taxonomy (name, labels, what it "
                         f"covers)",
+                    )
+            for name, node in health_rule_metrics_in_tree(mod.tree):
+                if name not in catalog:
+                    ctx.emit(
+                        findings, self.name, mod, node,
+                        f"health rule references metric '{name}' which "
+                        f"is not cataloged in {CATALOG_RELPATH} — an "
+                        f"alert definition cannot rot ahead of the "
+                        f"catalog (document the metric, or fix the "
+                        f"rule's expression)",
                     )
         return findings

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Health smoke gate (`make health-smoke`): deterministic alerting,
+pinned both ways.
+
+Two service runs over the same tenant mix (a healthy zdt1 tenant, a
+tenant whose objective HANGS past the eval timeout, a tenant returning
+NaN objectives), health engine on the deterministic rulebook
+(`default_rulebook(include_host=False)` — the host-contention rule is
+a function of the machine, not the run, and is excluded from pins):
+
+1. **fault-free run** — the seeded fault plan is absent; the engine
+   must fire NOTHING (`fired() == []`, `health_alerts_total` all zero);
+2. **chaos run** — the seeded ``DMOSOPT_FAULT_PLAN`` injects the hang
+   and NaN faults; the engine must fire EXACTLY the expected alert set
+   (rule names + severities), count each in
+   ``health_alerts_total{rule,severity}``, surface the alerts through
+   ``introspect()["health"]``, and resolve every alert once the faulty
+   tenants have been retired (the end state is quiet, not wedged).
+
+Evaluation is deterministic by construction (no clock, no RNG in any
+firing decision — dmosopt_tpu/telemetry/health.py), so this gate pins
+exact sets, not "at least one alert".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SMK = {"n_starts": 2, "n_iter": 20, "seed": 0}
+POLICY = {
+    "timeout": 0.15,
+    "retries": 0,
+    "on_eval_failure": "quorum",
+    "min_success_fraction": 0.5,
+    "max_failed_epochs": 2,
+}
+
+FAULT_PLAN = {
+    "seed": 11,
+    "rules": [
+        {"kind": "hang", "target": "h_hang", "delay_s": 0.6},
+        {"kind": "nan", "target": "h_nan", "p": 1.0},
+    ],
+}
+
+#: the pinned alert set the chaos run must fire, exactly
+EXPECTED_ALERTS = [
+    ("eval_failure_surge", "warning"),
+    ("eval_timeout_surge", "warning"),
+    ("tenant_quarantine_spike", "warning"),
+]
+
+
+def _host_zdt1(dim):
+    import numpy as np
+
+    def f(pp):
+        x = np.asarray(
+            [pp[f"x{i}"] for i in range(dim)], dtype=np.float64
+        )
+        f1 = x[0]
+        g = 1.0 + 9.0 * np.mean(x[1:])
+        f2 = g * (1.0 - np.sqrt(f1 / g))
+        return np.asarray([f1, f2], dtype=np.float64)
+
+    return f
+
+
+def _run_service(label):
+    from dmosopt_tpu.service import OptimizationService
+    from dmosopt_tpu.telemetry.health import default_rulebook
+
+    svc = OptimizationService(
+        min_bucket=2,
+        telemetry=True,
+        eval_policy=dict(POLICY),
+        health_rules=default_rulebook(include_host=False),
+    )
+
+    def submit(name, seed, policy=None):
+        svc.submit(
+            _host_zdt1(3),
+            {f"x{i}": [0.0, 1.0] for i in range(3)},
+            ["f1", "f2"],
+            opt_id=name, jax_objective=False,
+            population_size=16, num_generations=4, n_initial=3,
+            n_epochs=3, surrogate_method_kwargs=dict(SMK),
+            random_seed=seed, eval_policy=policy,
+        )
+
+    submit("h_ok", 21)
+    submit("h_hang", 22)
+    submit("h_nan", 23, policy=dict(POLICY, on_eval_failure="skip"))
+    svc.run()
+
+    engine = svc.health
+    snap = svc.introspect()
+    reg = svc.telemetry.registry
+    fired = engine.fired()
+    counters = {
+        (rule, sev): reg.counter_value(
+            "health_alerts_total", rule=rule, severity=sev
+        )
+        for rule, sev in EXPECTED_ALERTS
+    }
+    active = engine.active()
+    svc.close()
+    print(
+        f"[{label}] fired={fired} active={[a['rule'] for a in active]} "
+        f"tenant_counts={snap['tenant_counts']}"
+    )
+    return fired, counters, active, snap
+
+
+def main() -> int:
+    problems = []
+
+    os.environ.pop("DMOSOPT_FAULT_PLAN", None)
+    fired, counters, active, _ = _run_service("healthy")
+    if fired:
+        problems.append(f"healthy run fired alerts: {fired}")
+    if any(v > 0 for v in counters.values()):
+        problems.append(
+            f"healthy run counted health_alerts_total: {counters}"
+        )
+
+    os.environ["DMOSOPT_FAULT_PLAN"] = json.dumps(FAULT_PLAN)
+    try:
+        fired, counters, active, snap = _run_service("chaos")
+    finally:
+        os.environ.pop("DMOSOPT_FAULT_PLAN", None)
+
+    if fired != EXPECTED_ALERTS:
+        problems.append(
+            f"chaos run fired {fired}, expected exactly {EXPECTED_ALERTS}"
+        )
+    for key, v in counters.items():
+        if v < 1:
+            problems.append(f"health_alerts_total{key} did not count")
+    if active:
+        problems.append(
+            f"alerts still firing after the faulty tenants were "
+            f"retired: {[a['rule'] for a in active]} — the resolved "
+            f"side of the lifecycle did not run"
+        )
+    health = snap.get("health", {})
+    if health.get("transitions_total", 0) < 2 * len(EXPECTED_ALERTS):
+        problems.append(
+            f"introspect()['health'] shows "
+            f"{health.get('transitions_total')} transitions; expected "
+            f"firing+resolved for each of {len(EXPECTED_ALERTS)} alerts"
+        )
+
+    if problems:
+        print("HEALTH SMOKE FAILED:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(
+        f"health smoke OK: healthy run silent, chaos run fired exactly "
+        f"{[r for r, _ in EXPECTED_ALERTS]} and resolved all of them"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
