@@ -58,6 +58,22 @@ trace-smoke:
 chaos:
 	$(PY) tools/chaos_smoke.py
 
+# fleet chaos gate: 2-worker fleets of real subprocesses driven through
+# the whole worker failure model — seeded SIGKILL mid-epoch (fronts
+# must come back BITWISE-equal to an uninterrupted single-service run),
+# heartbeat-hang and partition (death must come from the
+# deadline/hysteresis policy and the fenced worker must exit through
+# its fence), and a >= 64-tenant soak under injected death (exact
+# migration counts, zero double adoption via the checkpoint ownership
+# lease, attributed-cost fairness within the documented bound).
+# Fast-suite smoke variant: tests/test_fleet_supervisor.py.
+# docs/robustness.md "Fleet failure model".
+chaos-fleet:
+	$(PY) tools/chaos_fleet_smoke.py
+
+chaos-fleet-fast:
+	$(PY) tools/chaos_fleet_smoke.py --skip-soak
+
 # health gate: deterministic alerting pinned both ways — a seeded
 # chaos plan (hang + NaN tenants) must fire EXACTLY the expected alert
 # set (rule names + severities) and resolve it once the faulty tenants

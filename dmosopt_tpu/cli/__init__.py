@@ -402,26 +402,52 @@ def telemetry(file_path, opt_id, problem_id, with_hv, output_file):
 
 
 @click.command("status")
-@click.option("--status-file", "-p", required=True,
+@click.option("--status-file", "-p", default=None,
               type=click.Path(exists=True),
               help="JSON snapshot the service writes after every step "
                    "(OptimizationService(status_path=...))")
+@click.option("--fleet-dir", "-d", default=None,
+              type=click.Path(exists=True, file_okay=False),
+              help="fleet directory (FleetSupervisor(fleet_dir=...)): "
+                   "aggregate every worker's status file plus the "
+                   "supervisor state — per-worker liveness, the tenant "
+                   "placement table, and the migration history")
 @click.option("--as-json", "as_json", is_flag=True,
               help="emit the raw snapshot JSON instead of the table")
 @click.option("--watch", "-w", default=0.0, type=float,
               help="re-render from the status file every N seconds "
                    "(live operation; Ctrl-C to stop)")
-def status(status_file, as_json, watch):
+def status(status_file, fleet_dir, as_json, watch):
     """Live-service introspection: render the snapshot an
     `OptimizationService(status_path=...)` publishes after every step —
     tenants with epoch/state/attributed cost, queue depths, writer
     backlog, telemetry series-overflow state, the health-alert block,
     and the loadavg-normalized throughput check (docs/observability.md).
-    With `--watch N` the table re-renders from the status file every N
-    seconds — the zero-dependency live dashboard."""
+    With `--fleet-dir` the same command aggregates a whole fleet
+    directory instead: per-worker liveness/heartbeat age/exporter
+    ports, the tenant placement table, and the migration history
+    (docs/robustness.md "Fleet failure model"). With `--watch N` the
+    table re-renders every N seconds — the zero-dependency live
+    dashboard."""
     import time as _time
 
+    if (status_file is None) == (fleet_dir is None):
+        raise click.ClickException(
+            "pass exactly one of --status-file/-p or --fleet-dir/-d"
+        )
+
     def render_once():
+        if fleet_dir is not None:
+            from dmosopt_tpu.telemetry.fleet import scan_fleet_dir
+
+            scan = scan_fleet_dir(fleet_dir)
+            if as_json:
+                click.echo(
+                    json.dumps(scan, indent=2, default=json_default)
+                )
+            else:
+                _render_fleet_status(scan)
+            return
         with open(status_file) as fh:
             snap = json.load(fh)
         if as_json:
@@ -435,14 +461,84 @@ def status(status_file, as_json, watch):
                 click.clear()
                 render_once()
                 click.echo(
-                    f"(watching {status_file} every {watch:g}s — "
-                    f"Ctrl-C to stop)"
+                    f"(watching {status_file or fleet_dir} every "
+                    f"{watch:g}s — Ctrl-C to stop)"
                 )
                 _time.sleep(watch)
         except KeyboardInterrupt:
             return
     else:
         render_once()
+
+
+def _render_fleet_status(scan):
+    """One rendering of a fleet-directory aggregation: per-worker
+    liveness lines, the placement table, migration history."""
+    import time as _time
+
+    state = scan.get("state") or {}
+    now = _time.time()
+    workers = scan.get("workers", [])
+    st_workers = state.get("workers", {})
+    click.echo(
+        f"fleet: {scan.get('fleet_dir')} — {len(workers)} worker(s), "
+        f"placement epoch {state.get('placement_epoch', 0)}, "
+        f"{len(state.get('migrations', []))} migration(s), "
+        f"{len(state.get('shed', []))} shed, "
+        f"lease_conflicts={state.get('lease_conflicts', 0)}"
+    )
+    header = (
+        f"{'worker':>8} {'state':>10} {'hb_age':>8} {'steps':>6} "
+        f"{'tenants':>8} {'exporter':>24}"
+    )
+    click.echo(header)
+    click.echo("-" * len(header))
+    for w in workers:
+        wid = w["worker_id"]
+        status = w.get("status") or {}
+        sup_state = (st_workers.get(wid) or {}).get("state")
+        state_str = sup_state or status.get("state", "?")
+        if w.get("fenced"):
+            state_str = "FENCED"
+        age = (
+            f"{max(now - float(status['ts']), 0.0):.1f}s"
+            if status.get("ts")
+            else "-"
+        )
+        exporter = (status.get("exporter") or {}).get("url") or "-"
+        tenants = status.get("tenants") or {}
+        click.echo(
+            f"{wid:>8} {state_str:>10} {age:>8} "
+            f"{str(status.get('steps', '-')):>6} "
+            f"{len(tenants):>8} {exporter:>24}"
+        )
+        if status.get("last_error"):
+            click.echo(f"  note: {status['last_error']}")
+    placements = state.get("placements", {})
+    tenant_states = state.get("tenants", {})
+    if placements:
+        header = f"{'tenant':>20} {'worker':>8} {'state':>10} {'budget':>8}"
+        click.echo(header)
+        click.echo("-" * len(header))
+        for opt_id in sorted(placements):
+            p = placements[opt_id]
+            click.echo(
+                f"{opt_id:>20} {p.get('worker', '?'):>8} "
+                f"{tenant_states.get(opt_id, '?'):>10} "
+                f"{str(p.get('budget', '-')):>8}"
+            )
+    for m in state.get("migrations", []):
+        click.echo(
+            f"migration @ epoch {m.get('placement_epoch')}: "
+            f"{m.get('from')} -> {m.get('to')} "
+            f"({len(m.get('tenants', []))} tenant(s): "
+            f"{','.join(m.get('tenants', []))}; "
+            f"cause: {m.get('cause', '?')})"
+        )
+    for s in state.get("shed", []):
+        click.echo(
+            f"shed: {s.get('opt_id')} ({s.get('reason')})"
+        )
 
 
 def _render_status(snap):
@@ -484,7 +580,14 @@ def _render_status(snap):
                 "written; optimization continues"
             )
     if snap.get("checkpoint_path"):
-        click.echo(f"checkpoint: {snap['checkpoint_path']}")
+        line = f"checkpoint: {snap['checkpoint_path']}"
+        lease = snap.get("lease") or {}
+        if lease.get("owner"):
+            line += (
+                f" (owner {lease['owner']}, placement epoch "
+                f"{lease.get('placement_epoch', 0)})"
+            )
+        click.echo(line)
     thr = snap.get("throughput", {})
     line = (
         f"throughput: {thr.get('status', 'no_data')} "
@@ -604,10 +707,15 @@ def _render_status(snap):
 
 
 @click.command("fleet")
-@click.option("--file-path", "-p", "file_paths", required=True,
+@click.option("--file-path", "-p", "file_paths", required=False,
               multiple=True, type=click.Path(exists=True),
               help="HDF5 store to scan (repeatable; results stores and "
                    "service checkpoints both work)")
+@click.option("--dir", "-d", "fleet_dirs", required=False, multiple=True,
+              type=click.Path(exists=True, file_okay=False),
+              help="fleet directory (repeatable): scan every worker "
+                   "checkpoint and per-tenant results store it holds "
+                   "(workers/*/checkpoint.h5 + results/*.h5)")
 @click.option("--signature", "-s", default=None,
               help="only report this problem signature (d<dim>_o<nobj>)")
 @click.option("--output-file", "-o", type=click.Path(), default=None,
@@ -615,18 +723,32 @@ def _render_status(snap):
 @click.option("--as-json", "as_json", is_flag=True,
               help="emit the fleet-summary JSON to stdout instead of "
                    "the table")
-def fleet(file_paths, signature, output_file, as_json):
+def fleet(file_paths, fleet_dirs, signature, output_file, as_json):
     """Fleet telemetry rollup: scan N runs' persisted telemetry
     (per-epoch summaries, spans, health alerts, warm-refit
     hyperparameter state) into per-problem-signature distributions —
     the substrate fleet-learned warm-start priors consume
-    (docs/observability.md "Fleet telemetry rollup")."""
-    from dmosopt_tpu.telemetry.fleet import fleet_summary, write_fleet_summary
+    (docs/observability.md "Fleet telemetry rollup"). `--dir` scans a
+    whole fleet directory (every worker checkpoint + results store) in
+    one flag."""
+    from dmosopt_tpu.telemetry.fleet import (
+        fleet_dir_stores,
+        fleet_summary,
+        write_fleet_summary,
+    )
 
+    paths = list(file_paths)
+    for d in fleet_dirs:
+        paths.extend(fleet_dir_stores(d))
+    if not paths:
+        raise click.ClickException(
+            "nothing to scan: pass --file-path/-p stores and/or a "
+            "--dir fleet directory containing checkpoints or results"
+        )
     if output_file is not None:
-        summary = write_fleet_summary(list(file_paths), output_file)
+        summary = write_fleet_summary(paths, output_file)
     else:
-        summary = fleet_summary(list(file_paths))
+        summary = fleet_summary(paths)
     if signature is not None:
         if signature not in summary["signatures"]:
             raise click.ClickException(

@@ -1,0 +1,29 @@
+"""Horizontally scaled service fleet (ROADMAP item 1).
+
+`dmosopt_tpu.fleet` runs N `OptimizationService` worker subprocesses
+under one supervisor and makes worker death a non-event: tenant
+placement with admission control and load shedding, liveness detection
+(``/healthz`` probes + status-file heartbeats under a deadline +
+hysteresis policy), and live tenant migration that uses the PR 10
+crash-safe checkpoints as the wire format — a SIGKILLed worker's
+tenants resume on a survivor bitwise-equal to an uninterrupted run,
+under an ownership lease that makes double adoption impossible
+(docs/robustness.md "Fleet failure model").
+
+Import surface: the supervisor side is import-light (no jax); the
+worker harness imports the service stack and is meant to run as
+``python -m dmosopt_tpu.fleet.worker`` inside its own process.
+"""
+
+from dmosopt_tpu.fleet.supervisor import (  # noqa: F401
+    AdmissionPolicy,
+    FleetAdmissionError,
+    FleetSupervisor,
+    LivenessPolicy,
+)
+from dmosopt_tpu.fleet.wire import (  # noqa: F401
+    EXIT_FENCED,
+    EXIT_OK,
+    results_dir,
+    worker_dir,
+)

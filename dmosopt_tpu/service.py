@@ -249,8 +249,19 @@ class OptimizationService:
         checkpoint_path: Optional[str] = None,
         health_rules=None,
         exporter=None,
+        owner: Optional[str] = None,
+        placement_epoch: int = 0,
     ):
         self.min_bucket = int(min_bucket)
+        # ownership lease (fleet migration wire format): `owner` names
+        # the worker process whose checkpoints these are; the
+        # supervisor's monotonically increasing `placement_epoch` is
+        # the fencing token a checkpoint claim must beat. Both are
+        # stamped into every checkpoint and verified by
+        # `adopt_checkpoint` so two workers can never own one tenant
+        # (docs/robustness.md "Fleet failure model").
+        self.owner = owner
+        self.placement_epoch = int(placement_epoch)
         self.telemetry = create_telemetry(telemetry)
         self._owns_telemetry = not isinstance(telemetry, Telemetry)
         self.logger = logger
@@ -360,6 +371,7 @@ class OptimizationService:
         evaluator=None,
         eval_policy: Union[None, Dict, EvalPolicy] = None,
         surrogate_refit=None,
+        objective_ref: Optional[str] = None,
         _restore: Optional[Dict[str, Any]] = None,
     ) -> TenantHandle:
         """Submit one optimization problem; it joins a bucket at the
@@ -376,6 +388,12 @@ class OptimizationService:
                 "the service runs surrogate-mode epochs; "
                 "surrogate_method_name=None is not supported"
             )
+        if obj_fun is None and objective_ref:
+            # the fleet wire format: a subprocess worker receives an
+            # importable "module:attr" name instead of a closure
+            from dmosopt_tpu.utils import import_object
+
+            obj_fun = import_object(objective_ref)
         policy = EvalPolicy.from_spec(eval_policy) or self.eval_policy
         tenant_id = next(self._ids)
         opt_id = opt_id or f"tenant_{tenant_id}"
@@ -441,6 +459,7 @@ class OptimizationService:
             "surrogate_method_kwargs": surrogate_method_kwargs,
             "random_seed": random_seed,
             "file_path": file_path,
+            "objective_ref": objective_ref,
             "eval_policy": asdict(policy) if policy is not None else None,
             "surrogate_refit": (
                 surrogate_refit
@@ -463,7 +482,9 @@ class OptimizationService:
         with self._lock:
             self._pending.append(tenant)
         if self.telemetry:
-            if _restore is not None:
+            if _restore is not None and _restore.get("adopted"):
+                self.telemetry.inc("tenants_adopted_total")
+            elif _restore is not None:
                 self.telemetry.inc("tenants_resumed_total")
             else:
                 self.telemetry.inc("tenants_submitted_total")
@@ -1070,6 +1091,11 @@ class OptimizationService:
                 "ts": time.time(),
                 "steps": self._steps_run,
                 "min_bucket": self.min_bucket,
+                # ownership lease: who wrote this snapshot, and at which
+                # placement epoch — what `claim_service_checkpoint`
+                # verifies before a migration may adopt these tenants
+                "owner": self.owner,
+                "placement_epoch": self.placement_epoch,
             },
             "tenants": {
                 str(t.handle.tenant_id): self._tenant_checkpoint(t)
@@ -1140,7 +1166,13 @@ class OptimizationService:
         t.failed_epochs = int(st.get("failed_epochs", 0))
         t.degraded = bool(st.get("degraded", False))
         t.quarantined_seen = int(st.get("quarantined_seen", 0))
-        t.handle.tenant_id = int(st["tenant_id"])
+        stored_tid = int(st["tenant_id"])
+        if not restore.get("adopted"):
+            # resume in a fresh process keeps the stored ids; an
+            # ADOPTING service already has its own tenants, so a
+            # migrated tenant takes a fresh id (its opt_id is the
+            # stable cross-worker identity)
+            t.handle.tenant_id = stored_tid
         for k, v in (st.get("cost_seconds") or {}).items():
             t.handle.cost_seconds[k] = float(v)
 
@@ -1157,6 +1189,9 @@ class OptimizationService:
         status_path: Optional[str] = None,
         default_eval_timeout: float = DEFAULT_EVAL_TIMEOUT,
         checkpoint: bool = True,
+        owner: Optional[str] = None,
+        placement_epoch: Optional[int] = None,
+        expected_owner: Optional[str] = None,
     ) -> Tuple["OptimizationService", Dict[str, TenantHandle]]:
         """Reconstruct a service from its epoch-boundary checkpoint.
 
@@ -1171,21 +1206,47 @@ class OptimizationService:
         (pinned by tests/test_service_robustness.py); fronts streamed
         before the crash are in the tenants' own ``file_path`` stores,
         not replayed. With ``checkpoint=True`` (default) the resumed
-        service keeps checkpointing to the same path."""
-        from dmosopt_tpu.storage import load_service_checkpoint_from_h5
+        service keeps checkpointing to the same path.
+
+        Lease handling (fleet migration, docs/robustness.md): with
+        ``expected_owner`` set, resume refuses a checkpoint whose
+        stored ``service.owner`` differs — the tenants were adopted by
+        someone else. ``owner``/``placement_epoch`` default to the
+        STORED lease, so a restarted worker resumes under its own
+        identity; a tenant whose config carries an ``objective_ref``
+        ("module:attr") needs no ``objectives`` entry."""
+        from dmosopt_tpu.storage import (
+            CheckpointLeaseError,
+            load_service_checkpoint_from_h5,
+        )
 
         data = load_service_checkpoint_from_h5(checkpoint_path)
+        svc_meta = data["service"]
+        stored_owner = svc_meta.get("owner")
+        stored_epoch = int(svc_meta.get("placement_epoch") or 0)
+        if expected_owner is not None and stored_owner != expected_owner:
+            raise CheckpointLeaseError(
+                f"resume: checkpoint {checkpoint_path!r} is owned by "
+                f"{stored_owner!r}, not {expected_owner!r} (placement "
+                f"epoch {stored_epoch}) — its tenants live elsewhere now"
+            )
         svc = cls(
             min_bucket=(
                 int(min_bucket)
                 if min_bucket is not None
-                else int(data["service"].get("min_bucket", 2))
+                else int(svc_meta.get("min_bucket", 2))
             ),
             telemetry=telemetry,
             logger=logger,
             status_path=status_path,
             default_eval_timeout=default_eval_timeout,
             checkpoint_path=checkpoint_path if checkpoint else None,
+            owner=owner if owner is not None else stored_owner,
+            placement_epoch=(
+                int(placement_epoch)
+                if placement_epoch is not None
+                else stored_epoch
+            ),
         )
         evaluators = evaluators or {}
         objectives = objectives or {}
@@ -1198,10 +1259,12 @@ class OptimizationService:
             opt_id = st["opt_id"]
             obj = objectives.get(opt_id)
             evaluator = evaluators.get(opt_id)
-            if obj is None and evaluator is None:
+            if obj is None and evaluator is None and not cfg.get(
+                "objective_ref"
+            ):
                 raise ValueError(
-                    f"resume: no objective (or evaluator) supplied for "
-                    f"stored tenant {opt_id!r}"
+                    f"resume: no objective (or evaluator, or stored "
+                    f"objective_ref) supplied for stored tenant {opt_id!r}"
                 )
             space = cfg.pop("space")
             objective_names = cfg.pop("objective_names")
@@ -1212,6 +1275,95 @@ class OptimizationService:
             max_tid = max(max_tid, int(st["tenant_id"]))
         svc._ids = itertools.count(max_tid + 1)
         return svc, handles
+
+    def adopt_checkpoint(
+        self,
+        checkpoint_path: str,
+        objectives: Optional[Dict[str, Any]] = None,
+        *,
+        expected_owner: Optional[str],
+        placement_epoch: int,
+        evaluators: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, TenantHandle]:
+        """Live tenant migration: adopt every incomplete tenant stored
+        in ANOTHER worker's epoch-boundary checkpoint into this running
+        service. The dead worker's tenants join this service's buckets
+        at the next `step()` and continue seeded-trajectory-equivalent
+        (the checkpoint restores archive, RNG state, epoch counters,
+        pending requests and degradation accounting — the same contract
+        `resume` pins bitwise).
+
+        The adoption first CLAIMS the checkpoint's ownership lease
+        (`storage.claim_service_checkpoint`): the stored owner must be
+        ``expected_owner`` and the stored placement epoch must be older
+        than ``placement_epoch``, and the claim rewrites the stored
+        lease to this service's ``owner`` — so a second adopter raises
+        `storage.CheckpointLeaseError` instead of double-owning the
+        tenants. Objective functions resolve per tenant from
+        ``objectives``/``evaluators`` or the stored ``objective_ref``.
+        Returns ``{opt_id: TenantHandle}`` for the adopted tenants."""
+        if self._closed:
+            raise RuntimeError("service is closed")
+        from dmosopt_tpu.storage import (
+            claim_service_checkpoint,
+            load_service_checkpoint_from_h5,
+        )
+
+        data = load_service_checkpoint_from_h5(checkpoint_path)
+        objectives = objectives or {}
+        evaluators = evaluators or {}
+        # validate EVERY stored tenant BEFORE claiming the lease: the
+        # claim is consumed (owner rewritten) even if adoption then
+        # fails, which would orphan the tenants — a validation error
+        # must leave the checkpoint adoptable by someone else
+        own_ids = {
+            t.handle.opt_id
+            for t in list(self._active.values()) + list(self._pending)
+        }
+        for key in data["tenants"]:
+            tp = data["tenants"][key]
+            cfg = tp["config"] or {}
+            opt_id = tp["state"]["opt_id"]
+            if opt_id in own_ids:
+                raise ValueError(
+                    f"adopt: tenant {opt_id!r} already lives in this "
+                    f"service — opt_ids are the cross-worker identity "
+                    f"and must be fleet-unique"
+                )
+            if (
+                objectives.get(opt_id) is None
+                and evaluators.get(opt_id) is None
+                and not cfg.get("objective_ref")
+            ):
+                raise ValueError(
+                    f"adopt: no objective (or evaluator, or stored "
+                    f"objective_ref) available for tenant {opt_id!r}"
+                )
+        claim_service_checkpoint(
+            checkpoint_path, expected_owner, self.owner,
+            int(placement_epoch), logger=self.logger,
+        )
+        handles: Dict[str, TenantHandle] = {}
+        for key in sorted(data["tenants"], key=int):
+            tp = dict(data["tenants"][key])
+            cfg = dict(tp["config"] or {})
+            st = tp["state"]
+            opt_id = st["opt_id"]
+            obj = objectives.get(opt_id)
+            evaluator = evaluators.get(opt_id)
+            space = cfg.pop("space")
+            objective_names = cfg.pop("objective_names")
+            tp["adopted"] = True
+            handles[opt_id] = self.submit(
+                obj, space, objective_names,
+                opt_id=opt_id, evaluator=evaluator, _restore=tp, **cfg,
+            )
+        self.logger.info(
+            f"adopted {len(handles)} tenant(s) from {checkpoint_path} "
+            f"(previous owner {expected_owner!r}, placement epoch "
+            f"{placement_epoch})"
+        )
+        return handles
 
     # ------------------------------------------------------ introspection
 
@@ -1354,6 +1506,10 @@ class OptimizationService:
                 ),
             },
             "checkpoint_path": self.checkpoint_path,
+            "lease": {
+                "owner": self.owner,
+                "placement_epoch": self.placement_epoch,
+            },
             "series_overflow_total": overflow,
             "last_step": dict(self._last_step),
             "throughput": self._throughput_check(),

@@ -542,7 +542,9 @@ def load_fronts_from_h5(fpath, opt_id):
 # --------------------------------------------------- service checkpointing
 
 #: bumped when the checkpoint layout changes incompatibly
-SERVICE_CHECKPOINT_VERSION = 1
+#: (v2: ownership lease — ``service.owner`` + ``service.placement_epoch``,
+#: the fleet migration wire-format stamp; docs/robustness.md "Fleet")
+SERVICE_CHECKPOINT_VERSION = 2
 
 #: per-tenant array columns a service checkpoint may carry
 _CHECKPOINT_ARRAYS = (
@@ -588,6 +590,71 @@ def save_service_checkpoint_to_h5(payload: Dict, fpath, logger=None):
             f"service checkpoint: {len(payload['tenants'])} tenant(s) "
             f"-> {fpath}"
         )
+
+
+class CheckpointLeaseError(RuntimeError):
+    """A service checkpoint's ownership lease refused a claim: the
+    stored owner is not the expected one (someone else already adopted
+    these tenants) or the stored placement epoch is not older than the
+    claimant's (a stale fencing token). Raised instead of adopting, so
+    two workers can never own the same tenant."""
+
+
+def claim_service_checkpoint(
+    fpath,
+    expected_owner: Optional[str],
+    new_owner: Optional[str],
+    placement_epoch: int,
+    logger=None,
+) -> Dict:
+    """Atomically (within one HDF5 open) transfer a checkpoint's
+    ownership lease to ``new_owner`` at ``placement_epoch`` — the
+    double-adoption guard of fleet tenant migration.
+
+    The claim succeeds only when the stored ``service.owner`` equals
+    ``expected_owner`` (the worker the supervisor declared dead) AND
+    the stored ``service.placement_epoch`` is strictly older than the
+    claimant's ``placement_epoch`` (the supervisor's monotonically
+    increasing fencing token). On success the service attribute is
+    rewritten in place with the new owner/epoch plus a
+    ``claimed_from`` trail, so any later claimant — a second survivor
+    handed the same migration order, a partitioned supervisor retrying
+    — reads the new owner, fails the expected-owner check, and raises
+    `CheckpointLeaseError` instead of adopting the same tenants twice.
+    Returns the stored service metadata as it was BEFORE the claim."""
+    h5py = _require_h5py()
+    with h5py.File(fpath, "r+") as h5:
+        fmt = h5.attrs.get("format")
+        if fmt != "dmosopt_tpu.service_checkpoint":
+            raise RuntimeError(
+                f"{fpath!r} is not a service checkpoint (format {fmt!r})"
+            )
+        svc = _load_json_attr(h5, "service", {})
+        stored_owner = svc.get("owner")
+        stored_epoch = int(svc.get("placement_epoch") or 0)
+        if expected_owner is not None and stored_owner != expected_owner:
+            raise CheckpointLeaseError(
+                f"checkpoint {fpath!r} is owned by {stored_owner!r}, not "
+                f"{expected_owner!r} — its tenants were already adopted "
+                f"(placement epoch {stored_epoch})"
+            )
+        if stored_epoch >= int(placement_epoch):
+            raise CheckpointLeaseError(
+                f"checkpoint {fpath!r} carries placement epoch "
+                f"{stored_epoch} >= claimant's {placement_epoch} — the "
+                f"claim's fencing token is stale"
+            )
+        before = dict(svc)
+        svc["owner"] = new_owner
+        svc["placement_epoch"] = int(placement_epoch)
+        svc["claimed_from"] = stored_owner
+        _json_attr(h5, "service", svc)
+    if logger is not None:
+        logger.info(
+            f"claimed service checkpoint {fpath}: {stored_owner!r} -> "
+            f"{new_owner!r} @ placement epoch {placement_epoch}"
+        )
+    return before
 
 
 def load_service_checkpoint_from_h5(fpath) -> Dict:

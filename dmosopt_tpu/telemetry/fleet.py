@@ -370,6 +370,76 @@ def rollup(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+# ------------------------------------------------------- fleet directories
+
+
+def fleet_dir_stores(fleet_dir: str) -> List[str]:
+    """Every HDF5 store a fleet directory holds: per-worker service
+    checkpoints (``workers/*/checkpoint.h5``) and per-tenant results
+    stores (``results/*.h5``) — the input set `fleet_summary` rolls up
+    for a whole fleet in one call (the ``fleet --dir`` CLI path).
+    Layout names come from `dmosopt_tpu.fleet.wire` (imported at call
+    time — the supervisor side imports this module's sibling package,
+    so a module-level import would be a cycle)."""
+    from dmosopt_tpu.fleet import wire
+
+    out: List[str] = []
+    workers_root = os.path.join(fleet_dir, "workers")
+    if os.path.isdir(workers_root):
+        for wid in sorted(os.listdir(workers_root)):
+            ck = os.path.join(workers_root, wid, wire.CHECKPOINT_FILE)
+            if os.path.isfile(ck):
+                out.append(ck)
+    results_root = wire.results_dir(fleet_dir)
+    if os.path.isdir(results_root):
+        for name in sorted(os.listdir(results_root)):
+            if name.endswith(".h5"):
+                out.append(os.path.join(results_root, name))
+    return out
+
+
+def scan_fleet_dir(fleet_dir: str) -> Dict[str, Any]:
+    """Aggregate one fleet directory's control plane: the supervisor
+    state file (placements, migration history, shed log) plus every
+    worker's latest status-file heartbeat — the ``status --fleet-dir``
+    CLI's data source. Liveness judgement is the CALLER's (it needs a
+    clock); this scan only reports each status's ``ts``."""
+    from dmosopt_tpu.fleet import wire
+
+    state = None
+    state_path = os.path.join(fleet_dir, wire.FLEET_STATE_FILE)
+    if os.path.isfile(state_path):
+        try:
+            state = wire.read_json(state_path)
+        except (OSError, ValueError):
+            state = None
+    workers: List[Dict[str, Any]] = []
+    workers_root = os.path.join(fleet_dir, "workers")
+    if os.path.isdir(workers_root):
+        for wid in sorted(os.listdir(workers_root)):
+            wdir = os.path.join(workers_root, wid)
+            if not os.path.isdir(wdir):
+                continue
+            try:
+                status = wire.read_json(os.path.join(wdir, wire.STATUS_FILE))
+            except (OSError, ValueError):
+                status = None
+            workers.append(
+                {
+                    "worker_id": wid,
+                    "dir": wdir,
+                    "status": status,
+                    "fenced": os.path.exists(
+                        os.path.join(wdir, wire.FENCE_FILE)
+                    ),
+                    "has_checkpoint": os.path.isfile(
+                        os.path.join(wdir, wire.CHECKPOINT_FILE)
+                    ),
+                }
+            )
+    return {"fleet_dir": fleet_dir, "state": state, "workers": workers}
+
+
 def fleet_summary(paths: List[str]) -> Dict[str, Any]:
     """Scan every store and fold the records — the one-call entry point
     the ``fleet`` CLI subcommand (and item 5's prior loader) uses."""

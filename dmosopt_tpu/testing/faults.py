@@ -25,7 +25,9 @@ eval timeout), ``delay`` (straggler: sleep, then succeed), ``nan``
 (return non-finite objectives "successfully" — the archive-poisoning
 case the quarantine guard exists for), ``io_error`` (transient
 `OSError` from a store write), ``kill`` (SIGKILL the process — the
-crash-resume test's deterministic kill switch).
+crash-resume test's deterministic kill switch), and the worker-level
+kinds ``heartbeat_hang`` / ``partition`` (op ``"worker"``, consumed by
+the fleet worker harness — see `dmosopt_tpu.fleet.worker`).
 
 Env gating: `OptimizationService` checks ``DMOSOPT_FAULT_PLAN`` (a JSON
 plan spec, or ``@/path/to/plan.json``) at construction and wraps every
@@ -51,10 +53,21 @@ import numpy as np
 #: environment variable holding a JSON plan spec (or ``@path`` to one)
 FAULT_PLAN_ENV = "DMOSOPT_FAULT_PLAN"
 
-FAULT_KINDS = ("raise", "hang", "delay", "nan", "io_error", "kill")
+FAULT_KINDS = (
+    "raise", "hang", "delay", "nan", "io_error", "kill",
+    # worker-level kinds (op="worker"; interpreted by the fleet worker
+    # harness once per supervision loop): "heartbeat_hang" suppresses
+    # the status-file heartbeat while the rule keeps firing (the
+    # wedged-but-alive worker the supervisor's deadline policy exists
+    # for), "partition" additionally closes the worker's metrics
+    # exporter so liveness probes blackhole (the network-partition
+    # shape: the worker keeps computing, the supervisor sees nothing)
+    "heartbeat_hang", "partition",
+)
 
-#: injection sites a rule can bind to
-FAULT_OPS = ("eval", "io")
+#: injection sites a rule can bind to ("worker" targets a fleet worker
+#: id, consulted once per worker supervision loop)
+FAULT_OPS = ("eval", "io", "worker")
 
 
 class InjectedFault(RuntimeError):

@@ -23,6 +23,25 @@ def json_default(o):
     )
 
 
+def import_object(ref: str):
+    """Resolve a ``"package.module:attr"`` reference to the object it
+    names — the wire format for objective functions in fleet tenant
+    specs and checkpoint ``objective_ref`` fields (a subprocess worker
+    cannot receive a closure; it receives a name it can import). The
+    attr part may be dotted (``mod:Class.method``)."""
+    module_name, sep, attr_path = ref.partition(":")
+    if not sep or not module_name or not attr_path:
+        raise ValueError(
+            f"object reference {ref!r} must look like 'package.module:attr'"
+        )
+    import importlib
+
+    obj = importlib.import_module(module_name)
+    for part in attr_path.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
 def jittered_backoff(attempt: int, base: float, cap: float) -> float:
     """Capped exponential backoff with jitter: ``min(base·2^attempt,
     cap)`` scaled uniformly into ``[0.5x, 1.0x)`` so simultaneous

@@ -1,0 +1,545 @@
+"""Fleet tier: worker supervision, failure detection, live migration.
+
+The acceptance shape (ISSUE 15): a 2-worker fleet with >= 4 tenants
+loses one worker to SIGKILL mid-epoch and NOTHING is lost — the
+survivor adopts the dead worker's tenants from its lease-stamped
+epoch-boundary checkpoint and every final front is bitwise-equal to an
+uninterrupted single-service run; the ownership lease makes double
+adoption structurally impossible (docs/robustness.md "Fleet failure
+model").
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dmosopt_tpu.fleet import (
+    AdmissionPolicy,
+    FleetAdmissionError,
+    FleetSupervisor,
+    LivenessPolicy,
+)
+from dmosopt_tpu.fleet.objectives import host_zdt1
+from dmosopt_tpu.fleet.wire import read_json
+from dmosopt_tpu.service import OptimizationService
+from dmosopt_tpu.storage import (
+    CheckpointLeaseError,
+    load_fronts_from_h5,
+    load_service_checkpoint_from_h5,
+)
+
+SMK = {"n_starts": 2, "n_iter": 20, "seed": 0}
+SPACE4 = {f"x{i}": [0.0, 1.0] for i in range(4)}
+SUBMIT_KW = dict(
+    jax_objective=False,
+    n_epochs=4,
+    population_size=16,
+    num_generations=4,
+    n_initial=3,
+    surrogate_method_kwargs=SMK,
+)
+OBJECTIVE_REF = "dmosopt_tpu.fleet.objectives:host_zdt1"
+
+
+def _fleet_spec(i, tmp_path, **overrides):
+    spec = {
+        "opt_id": f"t{i}",
+        "objective": OBJECTIVE_REF,
+        "space": dict(SPACE4),
+        "objective_names": ["f1", "f2"],
+        "random_seed": 40 + i,
+        "file_path": str(tmp_path / "results" / f"t{i}.h5"),
+        **SUBMIT_KW,
+    }
+    spec.update(overrides)
+    return spec
+
+
+def _fronts(handle):
+    return [(u.epoch, u.x.copy(), u.y.copy()) for u in handle.updates()]
+
+
+# --------------------------------------------------------------- lease unit
+
+
+def test_lease_claim_adopt_bitwise_and_double_adoption_refused(tmp_path):
+    """The migration wire format end-to-end, in-process: worker service
+    w0 checkpoints two epoch boundaries and 'dies'; a survivor service
+    that already owns a tenant adopts w0's checkpoint under the lease
+    protocol and finishes the migrated tenants BITWISE-equal to an
+    uninterrupted reference run. A second adoption attempt — the
+    double-ownership hazard — raises `CheckpointLeaseError`."""
+    ck = str(tmp_path / "w0.h5")
+
+    ref = OptimizationService(telemetry=False)
+    rh = {
+        f"t{i}": ref.submit(
+            host_zdt1, SPACE4, ["f1", "f2"],
+            opt_id=f"t{i}", random_seed=40 + i, **SUBMIT_KW,
+        )
+        for i in range(2)
+    }
+    ref.run()
+    ref_fronts = {k: _fronts(h) for k, h in rh.items()}
+    ref.close()
+
+    w0 = OptimizationService(
+        telemetry=False, checkpoint_path=ck, owner="w0", placement_epoch=0
+    )
+    for i in range(2):
+        w0.submit(
+            None, SPACE4, ["f1", "f2"], opt_id=f"t{i}",
+            random_seed=40 + i, objective_ref=OBJECTIVE_REF, **SUBMIT_KW,
+        )
+    w0.step()
+    w0.step()
+    # no close(): the checkpoint on disk is the last epoch boundary,
+    # exactly what a SIGKILL would leave
+
+    data = load_service_checkpoint_from_h5(ck)
+    assert data["service"]["owner"] == "w0"
+    assert data["service"]["placement_epoch"] == 0
+
+    w1 = OptimizationService(telemetry=True, owner="w1", placement_epoch=0)
+    own = w1.submit(
+        host_zdt1, SPACE4, ["f1", "f2"], opt_id="own",
+        random_seed=99, **SUBMIT_KW,
+    )
+    adopted = w1.adopt_checkpoint(ck, expected_owner="w0", placement_epoch=1)
+    assert sorted(adopted) == ["t0", "t1"]
+    assert (
+        w1.telemetry.registry.counter_value("tenants_adopted_total") == 2.0
+    )
+
+    # the claim rewrote the lease: a SECOND survivor handed the same
+    # migration order is refused before it can double-own the tenants
+    w2 = OptimizationService(telemetry=False, owner="w2")
+    with pytest.raises(CheckpointLeaseError):
+        w2.adopt_checkpoint(ck, expected_owner="w0", placement_epoch=2)
+    # and a stale fencing token is refused even with the right owner
+    with pytest.raises(CheckpointLeaseError):
+        w2.adopt_checkpoint(ck, expected_owner="w1", placement_epoch=1)
+    # the adopter itself re-running the order trips the duplicate
+    # opt_id validation BEFORE the lease is touched
+    with pytest.raises(ValueError):
+        w1.adopt_checkpoint(ck, expected_owner="w1", placement_epoch=2)
+    w2.close()
+    stamped = load_service_checkpoint_from_h5(ck)["service"]
+    assert stamped["owner"] == "w1"
+    assert stamped["placement_epoch"] == 1
+    assert stamped["claimed_from"] == "w0"
+
+    w1.run()
+    for k, h in adopted.items():
+        got = _fronts(h)
+        assert [e for e, _, _ in got] == [2, 3]
+        for (e, x, y), (er, xr, yr) in zip(got, ref_fronts[k][2:]):
+            assert e == er
+            np.testing.assert_array_equal(x, xr)
+            np.testing.assert_array_equal(y, yr)
+        assert h.done and h.error is None
+    assert own.done and own.error is None
+    w1.close()
+
+
+def test_resume_honors_and_checks_lease(tmp_path):
+    """`resume` keeps the stored lease identity by default and refuses
+    a checkpoint whose owner is not the expected one."""
+    ck = str(tmp_path / "svc.h5")
+    svc = OptimizationService(
+        telemetry=False, checkpoint_path=ck, owner="w7", placement_epoch=3
+    )
+    svc.submit(
+        None, SPACE4, ["f1", "f2"], opt_id="a", random_seed=1,
+        objective_ref=OBJECTIVE_REF, **SUBMIT_KW,
+    )
+    svc.step()
+
+    with pytest.raises(CheckpointLeaseError):
+        OptimizationService.resume(
+            ck, {}, telemetry=False, checkpoint=False,
+            expected_owner="someone_else",
+        )
+    svc2, handles = OptimizationService.resume(
+        ck, {}, telemetry=False, checkpoint=False, expected_owner="w7",
+    )
+    # no objectives dict needed: the stored objective_ref resolves
+    assert sorted(handles) == ["a"]
+    assert svc2.owner == "w7" and svc2.placement_epoch == 3
+    svc2.close()
+    svc.close()
+
+
+# --------------------------------------------------- admission + placement
+
+
+def _fake_status(wid, *, ts=None, tenants=None, load_ratio=0.1,
+                 thr_status="ok", exporter=None):
+    return {
+        "worker_id": wid,
+        "pid": 1,
+        "seq": 1,
+        "ts": time.time() if ts is None else ts,
+        "state": "running",
+        "steps": 1,
+        "exporter": exporter,
+        "tenants": tenants or {},
+        "lease_conflicts": 0,
+        "service": {
+            "throughput": {"status": thr_status, "load_ratio": load_ratio},
+        },
+    }
+
+
+def test_admission_caps_shedding_and_weighted_placement(tmp_path):
+    """Placement unit (no subprocesses): the EA-budget cap sheds,
+    all-contended sheds, and an unpinned submission lands on the
+    least-loaded worker by remaining-budget + attributed-cost weight."""
+    from dmosopt_tpu.fleet.wire import atomic_write_json, worker_dir
+
+    sup = FleetSupervisor(
+        str(tmp_path), n_workers=2, telemetry=True,
+        admission=AdmissionPolicy(max_ea_budget=1000),
+    )
+    for w in sup.workers.values():
+        os.makedirs(w.dir, exist_ok=True)
+        w.state = "alive"
+
+    # budget cap: 16 * 40 * 4 = 2560 > 1000 -> shed
+    with pytest.raises(FleetAdmissionError):
+        sup.submit(_fleet_spec(9, tmp_path, num_generations=40))
+    assert sup.shed[0]["reason"] == "budget"
+    assert (
+        sup.telemetry.registry.counter_value(
+            "fleet_tenants_shed_total", reason="budget"
+        )
+        == 1.0
+    )
+
+    # weighted placement: w0 is busy (an active tenant with most of its
+    # budget remaining plus attributed cost), w1 idle -> w1 wins
+    atomic_write_json(
+        os.path.join(worker_dir(str(tmp_path), "w0"), "status.json"),
+        _fake_status(
+            "w0",
+            tenants={
+                "busy": {
+                    "state": "active", "epoch": 0, "n_epochs": 4,
+                    "cost_seconds": {"fit": 5.0, "ea": 5.0},
+                }
+            },
+        ),
+    )
+    atomic_write_json(
+        os.path.join(worker_dir(str(tmp_path), "w1"), "status.json"),
+        _fake_status("w1"),
+    )
+    sup.placements["busy"] = {"worker": "w0", "budget": 256, "spec": {}}
+    placement = sup.submit(_fleet_spec(0, tmp_path))
+    assert placement["worker"] == "w1"
+    inbox = os.listdir(os.path.join(worker_dir(str(tmp_path), "w1"), "inbox"))
+    assert any(n.endswith("-submit.json") for n in inbox)
+
+    # every worker contended -> shed (the rejection path)
+    for wid in ("w0", "w1"):
+        atomic_write_json(
+            os.path.join(worker_dir(str(tmp_path), wid), "status.json"),
+            _fake_status(wid, thr_status="host_contended", load_ratio=9.9),
+        )
+    with pytest.raises(FleetAdmissionError):
+        sup.submit(_fleet_spec(1, tmp_path))
+    assert sup.shed[-1]["reason"] == "contended"
+    sup._closed = True  # no processes were spawned; nothing to stop
+
+
+def test_heartbeat_hysteresis_and_checkpointless_migration(tmp_path):
+    """Failure-detector unit (no subprocesses): a stale heartbeat must
+    persist for `confirm_rounds` CONSECUTIVE rounds before the worker
+    is declared dead; with no checkpoint on disk the migration falls
+    back to restart-from-spec submit orders on the survivor."""
+    from dmosopt_tpu.fleet.wire import atomic_write_json, worker_dir
+
+    sup = FleetSupervisor(
+        str(tmp_path), n_workers=2, telemetry=True,
+        liveness=LivenessPolicy(
+            heartbeat_timeout=5.0, confirm_rounds=2, fence_grace=0.1
+        ),
+    )
+    for w in sup.workers.values():
+        os.makedirs(w.dir, exist_ok=True)
+        w.state = "alive"
+        w.spawn_ts = time.monotonic()
+    atomic_write_json(
+        os.path.join(worker_dir(str(tmp_path), "w0"), "status.json"),
+        _fake_status("w0", ts=time.time() - 600.0),  # long stale
+    )
+    atomic_write_json(
+        os.path.join(worker_dir(str(tmp_path), "w1"), "status.json"),
+        _fake_status("w1"),
+    )
+    sup.placements["t0"] = {
+        "worker": "w0", "budget": 256, "spec": _fleet_spec(0, tmp_path),
+    }
+    sup.tenant_states["t0"] = "placed"
+
+    events = sup.monitor_once()
+    assert events == []  # round 1: suspect, hysteresis holds
+    assert sup.workers["w0"].state == "suspect"
+    events = sup.monitor_once()  # round 2: confirmed dead
+    kinds = [e["event"] for e in events]
+    assert "worker_dead" in kinds and "migration" in kinds
+    migration = next(e for e in events if e["event"] == "migration")
+    assert migration["checkpoint_claimed"] is False
+    assert migration["resubmitted"] == ["t0"]
+    assert sup.placements["t0"]["worker"] == "w1"
+    assert os.path.exists(
+        os.path.join(worker_dir(str(tmp_path), "w0"), "fence")
+    )
+    inbox = os.listdir(os.path.join(worker_dir(str(tmp_path), "w1"), "inbox"))
+    assert any(n.endswith("-submit.json") for n in inbox)
+    reg = sup.telemetry.registry
+    assert reg.counter_value("fleet_worker_deaths_total", worker="w0") == 1.0
+    assert reg.counter_value("fleet_migrations_total") == 1.0
+    # a healthy heartbeat never accumulates suspicion
+    assert sup.workers["w1"].suspect_rounds == 0
+    sup._closed = True
+
+
+# --------------------------------------------------------- worker harness
+
+
+def test_worker_harness_fault_kinds_and_flags(tmp_path, monkeypatch):
+    """Worker-level fault kinds and control flags, in-process: a
+    ``heartbeat_hang`` rule mutes the status heartbeat while it fires,
+    ``partition`` additionally closes the exporter (probe blackhole),
+    a fence flag exits with `EXIT_FENCED` writing nothing, a stop flag
+    closes gracefully."""
+    from dmosopt_tpu.fleet.wire import EXIT_FENCED, EXIT_OK, touch_flag
+    from dmosopt_tpu.fleet.worker import WorkerHarness
+
+    plan = {
+        "seed": 0,
+        "rules": [
+            {"kind": "heartbeat_hang", "op": "worker", "target": "wh",
+             "after": 0, "count": 2},
+            {"kind": "partition", "op": "worker", "target": "wh",
+             "after": 2, "count": 1},
+        ],
+    }
+    monkeypatch.setenv("DMOSOPT_FAULT_PLAN", json.dumps(plan))
+    h = WorkerHarness(
+        str(tmp_path), "wh", poll=0.01, exporter=True, telemetry=True
+    )
+    status0 = read_json(h._status_path)
+    assert status0["state"] == "starting"
+    assert status0["exporter"]["port"] > 0  # ephemeral bind surfaced
+
+    h.run(max_loops=2)  # both loops heartbeat_hang -> no status writes
+    st = read_json(h._status_path)
+    assert st["seq"] == status0["seq"] == 0  # heartbeat stayed muted
+    assert st["state"] == "starting"
+
+    h.run(max_loops=1)  # partition loop: exporter closed, still muted
+    assert h.service.exporter is None
+    assert read_json(h._status_path)["state"] == "starting"
+    h.run(max_loops=1)  # plan exhausted: heartbeat resumes
+    st = read_json(h._status_path)
+    assert st["state"] == "running" and st["seq"] >= 1
+    assert st["exporter"] is None  # the blackhole is visible
+    h.service.close()
+
+    # fence beats everything and writes nothing
+    h3 = WorkerHarness(str(tmp_path), "wf", poll=0.01, exporter=False,
+                       telemetry=False)
+    touch_flag(h3._fence_path)
+    before = read_json(h3._status_path)
+    assert h3.run() == EXIT_FENCED
+    assert read_json(h3._status_path) == before  # no further writes
+    h3.service.close()
+
+    h4 = WorkerHarness(str(tmp_path), "ws", poll=0.01, exporter=False,
+                       telemetry=False)
+    touch_flag(h4._stop_path)
+    assert h4.run() == EXIT_OK
+    assert read_json(h4._status_path)["state"] == "stopped"
+
+
+# ----------------------------------------------------- exporter coexistence
+
+
+def test_exporter_ephemeral_ports_coexist_and_surface(tmp_path):
+    """Multi-worker single-host satellite: N services with
+    ``exporter=True`` bind DISTINCT ephemeral ports, each surfaced
+    through ``introspect()["exporter"]`` and rendered by the `status`
+    CLI — and each /metrics endpoint serves its own registry."""
+    import urllib.request
+
+    from click.testing import CliRunner
+
+    from dmosopt_tpu.cli import status as status_cmd
+    from dmosopt_tpu.utils import json_default
+
+    svcs = [OptimizationService(telemetry=True, exporter=True)
+            for _ in range(3)]
+    try:
+        ports = [s.introspect()["exporter"]["port"] for s in svcs]
+        assert len(set(ports)) == 3 and all(p > 0 for p in ports)
+        for s in svcs:
+            snap = s.introspect()
+            url = snap["exporter"]["url"]
+            with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
+                assert r.status == 200
+
+        status_path = tmp_path / "status.json"
+        status_path.write_text(
+            json.dumps(svcs[0].introspect(), default=json_default)
+        )
+        out = CliRunner().invoke(status_cmd, ["-p", str(status_path)])
+        assert out.exit_code == 0, out.output
+        assert f":{ports[0]}" in out.output
+    finally:
+        for s in svcs:
+            s.close()
+
+
+# --------------------------------------------------------- subprocess fleet
+
+
+def _supervisor(tmp_path, n_workers=2, worker_env=None):
+    return FleetSupervisor(
+        str(tmp_path), n_workers=n_workers, telemetry=True,
+        liveness=LivenessPolicy(
+            heartbeat_timeout=20.0, confirm_rounds=2, fence_grace=10.0,
+            probe_timeout=2.0, probe_retries=1,
+        ),
+        worker_env=worker_env,
+        python=sys.executable,
+    )
+
+
+def test_fleet_kill9_migration_bitwise(tmp_path):
+    """THE acceptance test: 2 workers, 4 tenants (2 per worker), one
+    worker SIGKILLed mid-epoch by an armed eval-op kill rule. The
+    supervisor confirms the death, fences the corpse, claims its
+    checkpoint under the lease, and the survivor adopts — every tenant
+    completes, and ALL final fronts are bitwise-equal to an
+    uninterrupted single-service run of the same 4 tenants. Exactly
+    one migration, zero lease conflicts, no tenant ever owned twice."""
+    # ---- uninterrupted reference: one in-process service, same seeds
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    ref = OptimizationService(telemetry=False)
+    ref_handles = {}
+    for i in range(4):
+        ref_handles[f"t{i}"] = ref.submit(
+            host_zdt1, SPACE4, ["f1", "f2"], opt_id=f"t{i}",
+            random_seed=40 + i,
+            file_path=str(ref_dir / f"t{i}.h5"), **SUBMIT_KW,
+        )
+    ref.run()
+    ref.close()
+
+    # ---- the fleet: t0's 19th evaluation call SIGKILLs worker w0
+    # (12-point initial design + 4 per epoch: mid-epoch-3, two epoch
+    # boundaries durable — the _service_crash_worker shape, one level up)
+    plan = {
+        "seed": 0,
+        "rules": [{"kind": "kill", "target": "t0", "op": "eval",
+                   "after": 18}],
+    }
+    sup = _supervisor(
+        tmp_path, worker_env={"w0": {"DMOSOPT_FAULT_PLAN": json.dumps(plan)}}
+    )
+    with sup:
+        sup.start(timeout=120)
+        for i in range(4):
+            sup.submit(_fleet_spec(i, tmp_path), worker=f"w{i % 2}")
+        summary = sup.run(poll=0.2, timeout=600)
+
+    assert summary["tenants"] == {f"t{i}": "completed" for i in range(4)}
+    assert summary["workers"]["w0"]["state"] in ("dead", "fenced")
+    assert summary["workers"]["w0"]["exit_code"] == -9
+    assert len(summary["migrations"]) == 1
+    migration = summary["migrations"][0]
+    assert migration["from"] == "w0" and migration["to"] == "w1"
+    assert sorted(migration["tenants"]) == ["t0", "t2"]
+    assert migration["checkpoint_claimed"] is True
+    assert summary["lease_conflicts"] == 0
+
+    reg = sup.telemetry.registry
+    assert reg.counter_value("fleet_worker_deaths_total", worker="w0") == 1.0
+    assert reg.counter_value("fleet_migrations_total") == 1.0
+    assert reg.counter_value("fleet_tenants_migrated_total") == 2.0
+
+    # the lease pin: the dead worker's checkpoint is stamped with its
+    # adopter, so ANY later claim fails the expected-owner check
+    stamped = load_service_checkpoint_from_h5(
+        str(tmp_path / "workers" / "w0" / "checkpoint.h5")
+    )["service"]
+    assert stamped["owner"] == "w1" and stamped["claimed_from"] == "w0"
+    with pytest.raises(CheckpointLeaseError):
+        from dmosopt_tpu.storage import claim_service_checkpoint
+
+        claim_service_checkpoint(
+            str(tmp_path / "workers" / "w0" / "checkpoint.h5"),
+            "w0", "w9", 99,
+        )
+
+    # ---- bitwise: every tenant's every stored front epoch matches the
+    # uninterrupted run exactly (the migrated t0/t2 included)
+    for i in range(4):
+        opt_id = f"t{i}"
+        got = load_fronts_from_h5(
+            str(tmp_path / "results" / f"{opt_id}.h5"), opt_id
+        )
+        want = load_fronts_from_h5(str(ref_dir / f"{opt_id}.h5"), opt_id)
+        assert sorted(got) == sorted(want) == [0, 1, 2, 3]
+        for e in want:
+            np.testing.assert_array_equal(got[e][0], want[e][0],
+                                          err_msg=f"{opt_id} epoch {e} x")
+            np.testing.assert_array_equal(got[e][1], want[e][1],
+                                          err_msg=f"{opt_id} epoch {e} y")
+
+
+def test_fleet_smoke_and_cli_aggregation(tmp_path):
+    """Fast fleet smoke: 2 workers, 2 tenants, no faults — distinct
+    ephemeral exporter ports, graceful stop, and the `status
+    --fleet-dir` / `fleet --dir` CLI aggregations render the worker
+    liveness + placement tables from the directory alone."""
+    from click.testing import CliRunner
+
+    from dmosopt_tpu.cli import fleet as fleet_cmd
+    from dmosopt_tpu.cli import status as status_cmd
+
+    sup = _supervisor(tmp_path)
+    with sup:
+        sup.start(timeout=120)
+        for i in range(2):
+            sup.submit(
+                _fleet_spec(i, tmp_path, n_epochs=2), worker=f"w{i}"
+            )
+        summary = sup.run(poll=0.2, timeout=300)
+    assert summary["tenants"] == {"t0": "completed", "t1": "completed"}
+    ports = {
+        wid: (w.get("exporter") or {}).get("port")
+        for wid, w in summary["workers"].items()
+    }
+    assert None not in ports.values() and len(set(ports.values())) == 2
+    assert summary["migrations"] == [] and summary["lease_conflicts"] == 0
+
+    out = CliRunner().invoke(status_cmd, ["-d", str(tmp_path)])
+    assert out.exit_code == 0, out.output
+    assert "w0" in out.output and "w1" in out.output
+    assert "t0" in out.output and "completed" in out.output
+
+    out = CliRunner().invoke(fleet_cmd, ["--dir", str(tmp_path)])
+    assert out.exit_code == 0, out.output
+    assert "fleet:" in out.output
+
+    # exactly one of -p/-d is required
+    out = CliRunner().invoke(status_cmd, [])
+    assert out.exit_code != 0

@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Fleet chaos gate (`make chaos-fleet`): drive 2-worker fleets through
+the whole worker failure model and assert the migration contract.
+
+Four seeded scenarios, each over real worker subprocesses
+(docs/robustness.md "Fleet failure model"):
+
+1. **kill** — an armed eval-op ``kill`` rule SIGKILLs worker w0
+   mid-epoch (the `_service_crash_worker` shape, one level up). The
+   supervisor confirms via process exit, fences, claims w0's
+   epoch-boundary checkpoint under the ownership lease, and the
+   survivor adopts. Asserts: every tenant completes, EXACTLY one
+   migration of exactly w0's tenants, zero lease conflicts, the
+   checkpoint lease stamped w0 -> w1, and every stored front
+   BITWISE-equal to an uninterrupted single-service reference run.
+2. **heartbeat-hang** — a worker-op ``heartbeat_hang`` rule mutes w0's
+   status heartbeat while its process keeps running. The supervisor
+   must NOT react to one stale round (hysteresis), then declare death
+   by heartbeat deadline, fence, and migrate; the fenced worker exits
+   with `EXIT_FENCED` on its own.
+3. **partition** — a worker-op ``partition`` rule closes w0's exporter
+   (probe blackhole) and mutes its heartbeat: the network-partition
+   shape. Same contract as 2; the fence-grace-then-kill protocol
+   guarantees the corpse is gone before its checkpoint is claimed, so
+   split-brain cannot write anywhere.
+4. **soak** — >= 64 tenants across 2 workers under an injected
+   worker-op ``kill``: all 64 complete, exactly one migration, zero
+   double adoption, and per-tenant attributed ``tenant_cost_seconds``
+   stay within the documented fairness bound
+   (max/min <= FAIRNESS_BOUND across all tenants).
+
+``--skip-soak`` drops scenario 4 (the slow one); the fast-suite smoke
+variant of this gate is tests/test_fleet_supervisor.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: documented fairness bound: max/min per-tenant attributed cost share
+#: across identically configured soak tenants, worker death included
+FAIRNESS_BOUND = 8.0
+
+SMK = {"n_starts": 2, "n_iter": 20, "seed": 0}
+SPACE4 = {f"x{i}": [0.0, 1.0] for i in range(4)}
+OBJECTIVE_REF = "dmosopt_tpu.fleet.objectives:host_zdt1"
+SUBMIT_KW = dict(
+    jax_objective=False,
+    n_epochs=4,
+    population_size=16,
+    num_generations=4,
+    n_initial=3,
+    surrogate_method_kwargs=SMK,
+)
+
+
+def _spec(i, fleet_dir, **overrides):
+    spec = {
+        "opt_id": f"t{i}",
+        "objective": OBJECTIVE_REF,
+        "space": dict(SPACE4),
+        "objective_names": ["f1", "f2"],
+        "random_seed": 40 + i,
+        "file_path": os.path.join(fleet_dir, "results", f"t{i}.h5"),
+        **SUBMIT_KW,
+    }
+    spec.update(overrides)
+    return spec
+
+
+def _supervisor(fleet_dir, worker_env=None, **liveness_overrides):
+    from dmosopt_tpu.fleet import FleetSupervisor, LivenessPolicy
+
+    liveness = dict(
+        heartbeat_timeout=20.0,
+        confirm_rounds=2,
+        fence_grace=10.0,
+        probe_timeout=2.0,
+        probe_retries=1,
+    )
+    liveness.update(liveness_overrides)
+    return FleetSupervisor(
+        fleet_dir, n_workers=2, telemetry=True,
+        liveness=LivenessPolicy(**liveness),
+        worker_env=worker_env,
+    )
+
+
+def _require(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+
+
+# ------------------------------------------------------------ scenario: kill
+
+
+def scenario_kill(root: str) -> None:
+    import numpy as np
+
+    from dmosopt_tpu.fleet.objectives import host_zdt1
+    from dmosopt_tpu.service import OptimizationService
+    from dmosopt_tpu.storage import (
+        load_fronts_from_h5,
+        load_service_checkpoint_from_h5,
+    )
+
+    print("== scenario 1: SIGKILL mid-epoch ==")
+    fleet_dir = os.path.join(root, "kill")
+    ref_dir = os.path.join(root, "kill_ref")
+    os.makedirs(ref_dir)
+
+    ref = OptimizationService(telemetry=False)
+    for i in range(4):
+        ref.submit(
+            host_zdt1, SPACE4, ["f1", "f2"], opt_id=f"t{i}",
+            random_seed=40 + i,
+            file_path=os.path.join(ref_dir, f"t{i}.h5"), **SUBMIT_KW,
+        )
+    ref.run()
+    ref.close()
+
+    plan = {
+        "seed": 0,
+        "rules": [{"kind": "kill", "target": "t0", "op": "eval",
+                   "after": 18}],
+    }
+    sup = _supervisor(
+        fleet_dir,
+        worker_env={"w0": {"DMOSOPT_FAULT_PLAN": json.dumps(plan)}},
+    )
+    with sup:
+        sup.start(timeout=120)
+        for i in range(4):
+            sup.submit(_spec(i, fleet_dir), worker=f"w{i % 2}")
+        summary = sup.run(poll=0.2, timeout=600)
+
+    _require(
+        summary["tenants"] == {f"t{i}": "completed" for i in range(4)},
+        f"tenants did not all complete: {summary['tenants']}",
+    )
+    _require(
+        summary["workers"]["w0"]["exit_code"] == -9,
+        f"w0 exit {summary['workers']['w0']['exit_code']} != SIGKILL",
+    )
+    _require(
+        len(summary["migrations"]) == 1,
+        f"expected exactly 1 migration, got {summary['migrations']}",
+    )
+    mig = summary["migrations"][0]
+    _require(
+        sorted(mig["tenants"]) == ["t0", "t2"] and mig["to"] == "w1"
+        and mig["checkpoint_claimed"],
+        f"bad migration record: {mig}",
+    )
+    _require(
+        summary["lease_conflicts"] == 0,
+        f"lease conflicts: {summary['lease_conflicts']}",
+    )
+    stamped = load_service_checkpoint_from_h5(
+        os.path.join(fleet_dir, "workers", "w0", "checkpoint.h5")
+    )["service"]
+    _require(
+        stamped["owner"] == "w1" and stamped["claimed_from"] == "w0",
+        f"lease not stamped to adopter: {stamped}",
+    )
+    for i in range(4):
+        opt_id = f"t{i}"
+        got = load_fronts_from_h5(
+            os.path.join(fleet_dir, "results", f"{opt_id}.h5"), opt_id
+        )
+        want = load_fronts_from_h5(
+            os.path.join(ref_dir, f"{opt_id}.h5"), opt_id
+        )
+        _require(
+            sorted(got) == sorted(want) == [0, 1, 2, 3],
+            f"{opt_id}: epochs {sorted(got)} vs {sorted(want)}",
+        )
+        for e in want:
+            np.testing.assert_array_equal(got[e][0], want[e][0])
+            np.testing.assert_array_equal(got[e][1], want[e][1])
+    print("   kill: 1 migration, fronts bitwise-equal, lease pinned OK")
+
+
+# -------------------------------------------- scenarios: hang + partition
+
+
+def _silent_death_scenario(root: str, name: str, kind: str) -> None:
+    """Shared body of heartbeat-hang and partition: the worker is alive
+    but invisible; death must come from the deadline/hysteresis policy
+    and the worker must exit through its fence."""
+    from dmosopt_tpu.fleet.wire import EXIT_FENCED
+
+    print(f"== scenario {name}: worker-op {kind} ==")
+    fleet_dir = os.path.join(root, name)
+    # after=4: the worker completes a few supervision loops (tenants
+    # admitted, first epochs stepped) before going silent, forever
+    plan = {
+        "seed": 0,
+        "rules": [{"kind": kind, "target": "w0", "op": "worker",
+                   "after": 4}],
+    }
+    sup = _supervisor(
+        fleet_dir,
+        worker_env={"w0": {"DMOSOPT_FAULT_PLAN": json.dumps(plan)}},
+        heartbeat_timeout=6.0,
+    )
+    with sup:
+        sup.start(timeout=120)
+        for i in range(2):
+            # long-lived tenants: the silent worker must still be
+            # mid-run when the deadline policy confirms its death
+            sup.submit(
+                _spec(i, fleet_dir, n_epochs=16), worker=f"w{i}"
+            )
+        summary = sup.run(poll=0.5, timeout=600)
+
+    _require(
+        summary["tenants"] == {"t0": "completed", "t1": "completed"},
+        f"tenants did not all complete: {summary['tenants']}",
+    )
+    _require(
+        len(summary["migrations"]) == 1
+        and summary["migrations"][0]["tenants"] == ["t0"],
+        f"expected exactly one migration of t0: {summary['migrations']}",
+    )
+    _require(
+        summary["lease_conflicts"] == 0,
+        f"lease conflicts: {summary['lease_conflicts']}",
+    )
+    w0 = summary["workers"]["w0"]
+    _require(
+        w0["exit_code"] == EXIT_FENCED,
+        f"fenced worker should exit {EXIT_FENCED}, got {w0['exit_code']}",
+    )
+    print(f"   {name}: death by deadline policy, fence honored, "
+          f"1 migration OK")
+
+
+# ------------------------------------------------------------ scenario: soak
+
+
+def scenario_soak(root: str, n_tenants: int = 64) -> None:
+    print(f"== scenario 4: soak — {n_tenants} tenants, injected death ==")
+    fleet_dir = os.path.join(root, "soak")
+    # t0's 12th evaluation call SIGKILLs w0 (8-point initial design +
+    # 2 resamples/epoch: mid-epoch-3, t0's LAST epoch) — by then every
+    # w0 tenant has joined and checkpointed at least one boundary, so
+    # the whole half-fleet is adopted mid-flight
+    plan = {
+        "seed": 0,
+        "rules": [{"kind": "kill", "target": "t0", "op": "eval",
+                   "after": 11}],
+    }
+    sup = _supervisor(
+        fleet_dir,
+        worker_env={"w0": {"DMOSOPT_FAULT_PLAN": json.dumps(plan)}},
+    )
+    soak_kw = dict(
+        n_epochs=3, population_size=8, num_generations=2, n_initial=2,
+        surrogate_method_kwargs={"n_starts": 1, "n_iter": 10, "seed": 0},
+        file_path=None,
+    )
+    with sup:
+        sup.start(timeout=120)
+        for i in range(n_tenants):
+            sup.submit(_spec(i, fleet_dir, **soak_kw), worker=f"w{i % 2}")
+        summary = sup.run(poll=0.3, timeout=900)
+
+    states = set(summary["tenants"].values())
+    _require(
+        states == {"completed"}
+        and len(summary["tenants"]) == n_tenants,
+        f"not all {n_tenants} tenants completed: "
+        f"{ {s: list(summary['tenants'].values()).count(s) for s in states} }",
+    )
+    _require(
+        len(summary["migrations"]) == 1,
+        f"expected exactly 1 migration, got {len(summary['migrations'])}",
+    )
+    _require(
+        summary["lease_conflicts"] == 0,
+        f"lease conflicts: {summary['lease_conflicts']}",
+    )
+    # zero double adoption: each migrated tenant appears exactly once
+    # across every adoption any worker reported
+    adopted = []
+    for w in sup.workers.values():
+        for a in (w.status or {}).get("adoptions") or []:
+            adopted.extend(a["tenants"])
+    _require(
+        len(adopted) == len(set(adopted)),
+        f"a tenant was adopted twice: {sorted(adopted)}",
+    )
+    # every moved tenant is covered exactly once: adopted from the
+    # checkpoint, requeued (submit order the dead worker never
+    # claimed), or restarted-from-spec — and the adoption path carried
+    # a substantial share (the death really was mid-flight)
+    mig = summary["migrations"][0]
+    covered = set(adopted) | set(mig.get("requeued_orders", []))
+    covered |= set(mig.get("resubmitted", []))
+    _require(
+        covered == set(mig["tenants"]),
+        f"migration coverage mismatch: moved {sorted(mig['tenants'])} "
+        f"vs covered {sorted(covered)}",
+    )
+    _require(
+        len(set(adopted)) >= n_tenants // 4,
+        f"too few tenants adopted mid-flight ({len(set(adopted))}) — "
+        f"the injected death fired before the fleet was loaded",
+    )
+    # fairness: max/min per-tenant attributed cost within the bound
+    costs = {}
+    for w in sup.workers.values():
+        for opt_id, st in ((w.status or {}).get("tenants") or {}).items():
+            total = sum((st.get("cost_seconds") or {}).values())
+            costs[opt_id] = max(costs.get(opt_id, 0.0), total)
+    shares = [c for c in costs.values() if c > 0]
+    _require(
+        len(shares) >= n_tenants * 0.9,
+        f"attributed costs missing for most tenants ({len(shares)})",
+    )
+    ratio = max(shares) / min(shares)
+    _require(
+        ratio <= FAIRNESS_BOUND,
+        f"cost fairness ratio {ratio:.2f} exceeds bound {FAIRNESS_BOUND}",
+    )
+    print(
+        f"   soak: {n_tenants} tenants completed through 1 worker death; "
+        f"adopted {len(set(adopted))} once each; cost fairness "
+        f"max/min {ratio:.2f} <= {FAIRNESS_BOUND}"
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--skip-soak", action="store_true",
+                        help="run only the three fast scenarios")
+    parser.add_argument("--soak-tenants", type=int, default=64)
+    args = parser.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = tempfile.mkdtemp(prefix="dmosopt_chaos_fleet_")
+    print(f"chaos-fleet: working under {root}")
+    scenario_kill(root)
+    _silent_death_scenario(root, "hang", "heartbeat_hang")
+    _silent_death_scenario(root, "partition", "partition")
+    if not args.skip_soak:
+        scenario_soak(root, args.soak_tenants)
+    print("chaos-fleet: ALL SCENARIOS PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

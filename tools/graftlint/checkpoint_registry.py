@@ -21,7 +21,10 @@ incompatible.
 """
 
 #: must equal storage.SERVICE_CHECKPOINT_VERSION (cross-checked)
-SCHEMA_VERSION = 1
+#: (v2: the fleet ownership lease — ``service.owner`` +
+#: ``service.placement_epoch``, consumed by ``resume``'s lease check
+#: and ``claim_service_checkpoint``'s double-adoption guard)
+SCHEMA_VERSION = 2
 
 #: where the payload is WRITTEN: section -> producer functions whose
 #: dict literals / subscript stores define the field set
@@ -46,6 +49,8 @@ READERS = [
 FIELDS = {
     "service": {
         "min_bucket": {},
+        "owner": {},
+        "placement_epoch": {},
         "steps": {"write_only": True,
                   "reason": "service step counter, informational"},
         "ts": {"write_only": True,
