@@ -124,6 +124,14 @@ bench-gp-sharded:
 bench-tenants:
 	env DMOSOPT_BENCH_ONLY=multi_tenant python bench.py
 
+# the async task-graph scheduler vs the lockstep step (ISSUE 19): wall
+# and trace-derived device_busy_fraction at T in {16, 64} tenants over
+# four static buckets, scheduler-on vs lockstep. Acceptance gate:
+# scheduler-on device_busy_fraction >= 0.225 at T=64 (device truth from
+# the ledger). Override counts with DMOSOPT_BENCH_TASKGRAPH_TENANTS
+bench-taskgraph:
+	env DMOSOPT_BENCH_ONLY=task_graph python bench.py
+
 # Warm .jax_bench_cache with the EXACT programs the round-end bench
 # compiles: one full bench pass, JSON line discarded. Run AFTER the last
 # code commit — any change to optimizer state layouts or jitted program

@@ -26,6 +26,7 @@ import contextlib
 import inspect
 import itertools
 import time
+from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 from dmosopt_tpu.telemetry import phase_scope, span_scope
@@ -256,6 +257,34 @@ def _record_program_compile(
     )
 
 
+def _fused_generation_total(termination, interval: int) -> int:
+    """Total generations the chunked host loop would run under a plain
+    maximum-generation criterion — or 0 when the stopping rule is
+    data-dependent (any other criterion, forced termination, infinite
+    cap) and must actually be checked on host between chunks.
+
+    The chunked loop checks `terminated()` at generations 0, I, 2I, …
+    and stops at the first multiple of the check interval I strictly
+    greater than ``n_max_gen`` (`MaximumGenerationTermination` continues
+    while ``n_gen <= n_max_gen``), so it always runs exactly
+    ``I * (n_max_gen // I + 1)`` generations. Knowing that count up
+    front lets `_optimize_on_device` fuse the whole budget into one
+    scanned program."""
+    from dmosopt_tpu.termination import MaximumGenerationTermination
+
+    # exact type: a subclass may override _do_continue with a
+    # data-dependent rule, and TerminationCollection composes criteria
+    if type(termination) is not MaximumGenerationTermination:
+        return 0
+    if termination.force_termination:
+        return 0
+    m = termination.n_max_gen
+    if not np.isfinite(m):
+        return 0
+    interval = max(1, int(interval))
+    return interval * (int(m) // interval + 1)
+
+
 def _optimize_on_device(
     optimizer,
     eval_fn,
@@ -330,7 +359,15 @@ def _optimize_on_device(
         state = optimizer.update_strategy(state, x_gen, y_gen)
         return state, (x_gen, y_gen)
 
-    @jax.jit
+    # buffer donation: the carried optimizer state is dead after every
+    # chunk (the caller always overwrites `optimizer.state` with the
+    # scan carry), so on accelerators the input state buffers are
+    # donated to the output and the fused whole-budget program below
+    # runs without doubling the state footprint. CPU has no donation
+    # (XLA warns and copies), so the frozen CPU path keeps plain jit.
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+
+    @partial(jax.jit, donate_argnums=donate)
     def run_chunk_jit(state, keys):  # graftlint: disable=retrace-hazard -- built once per optimize() call, reused for every generation chunk; `step` closes over this call's optimizer/eval_fn by design
         return jax.lax.scan(step, state, keys)
 
@@ -406,6 +443,37 @@ def _optimize_on_device(
             gen, n_eval, LazyHostArray(pop_x), LazyHostArray(pop_y), None
         )
         return termination.has_terminated(opt)
+
+    # ---- fused sequential path: under a plain maximum-generation
+    # criterion the whole budget is known up front, so the
+    # chunk-per-host-check loop collapses into ONE scanned program over
+    # every generation (no host round-trip per chunk). The host derives
+    # the identical per-chunk key schedule first, so the trajectory is
+    # bitwise-equal to the chunked loop — pinned against it as the
+    # parity oracle in tests/test_moasmo.py. The while loop below stays
+    # the authority: its first `terminated()` call after the fused run
+    # fires the criterion's stop bookkeeping/log exactly as the chunked
+    # loop's last check did, and had the fused count been merely a
+    # prefix it would simply continue chunk-by-chunk.
+    fused_gens = 0
+    if termination is not None and not adaptive and eval_budget is None:
+        fused_gens = _fused_generation_total(
+            termination, termination_check_interval
+        )
+    if fused_gens:
+        n = termination_check_interval
+        chunk_keys = []
+        for _ in range(fused_gens // n):
+            key, k = jax.random.split(key)
+            chunk_keys.append(jax.random.split(k, n))
+        keys = jnp.concatenate(chunk_keys, axis=0)
+        state, (x_traj, y_traj) = run_chunk(optimizer.state, keys)
+        x_chunks.append(_as_np(x_traj))
+        y_chunks.append(_as_np(y_traj))
+        gen_counts.extend([x_traj.shape[1]] * fused_gens)
+        gen += fused_gens
+        n_eval += fused_gens * x_traj.shape[1]
+        optimizer.state = state
 
     while not terminated():
         n = termination_check_interval

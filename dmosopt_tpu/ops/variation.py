@@ -6,10 +6,107 @@ The reference applies these one parent at a time inside Python loops
 are batched over the whole offspring set so one fused XLA kernel produces a
 generation; weighted sampling-without-replacement uses the Gumbel top-k
 trick instead of ``Generator.choice``.
+
+SBX + mutation are the residual per-generation elementwise block left
+after the rank sweep was tiled, so their math is split into pure cores
+over PRECOMPUTED uniforms (`_mutation_core` / `_sbx_core` — the
+bitwise-frozen dense path, always used on CPU) with a Pallas TPU kernel
+variant behind them: on the TPU backend (or with ``DMOSOPT_PALLAS``
+forced, which runs the same kernel in interpret mode off-TPU) the core
+runs as one explicit VMEM-resident kernel instead of leaving the
+delta/beta fusion to XLA. Drawing the uniforms OUTSIDE the kernel keeps
+the key->value schedule identical on every route, so switching routes
+never perturbs a trajectory's RNG stream.
 """
+
+import os
 
 import jax
 import jax.numpy as jnp
+
+
+def _pallas_route() -> bool:
+    """True when the variation cores should run as Pallas kernels:
+    forced on/off by ``DMOSOPT_PALLAS`` (any truthy/falsy value), else
+    automatic on the TPU backend only — CPU stays on the frozen dense
+    path by default."""
+    env = os.environ.get("DMOSOPT_PALLAS")
+    if env is not None:
+        return env.lower() not in ("", "0", "false", "no")
+    return jax.default_backend() == "tpu"
+
+
+def _mutation_core(u, parents, di, xlb, xub, mutation_rate):
+    """Polynomial-mutation math over precomputed uniforms ``u`` — the
+    frozen dense path (reference dmosopt/MOEA.py:191-212)."""
+    pw = 1.0 / (di + 1.0)
+    delta_lo = (2.0 * u) ** pw - 1.0
+    delta_hi = 1.0 - (2.0 * (1.0 - u)) ** pw
+    delta = jnp.where(u < mutation_rate, delta_lo, delta_hi)
+    return jnp.clip(parents + (xub - xlb) * delta, xlb, xub)
+
+
+def _sbx_core(u, parents1, parents2, di, xlb, xub):
+    """SBX math over precomputed uniforms ``u`` — the frozen dense path
+    (reference dmosopt/MOEA.py:215-239)."""
+    pw = 1.0 / (di + 1.0)
+    beta = jnp.where(
+        u <= 0.5,
+        (2.0 * u) ** pw,
+        (1.0 / (2.0 * (1.0 - u))) ** pw,
+    )
+    c1 = 0.5 * ((1.0 - beta) * parents1 + (1.0 + beta) * parents2)
+    c2 = 0.5 * ((1.0 + beta) * parents1 + (1.0 - beta) * parents2)
+    return jnp.clip(c1, xlb, xub), jnp.clip(c2, xlb, xub)
+
+
+def _broadcast_operands(shape, dtype, *args):
+    """Broadcast every per-gene/scalar operand to the full (B, n) block
+    so the Pallas kernels see uniformly-ranked 2D refs (TPU Mosaic
+    prefers >=2D operands; the broadcasts fuse away under jit)."""
+    return [
+        jnp.broadcast_to(jnp.asarray(a, dtype), shape) for a in args
+    ]
+
+
+def _mutation_pallas(u, parents, di, xlb, xub, mutation_rate):
+    from jax.experimental import pallas as pl
+
+    def kernel(u_ref, p_ref, di_ref, lb_ref, ub_ref, rate_ref, out_ref):
+        out_ref[...] = _mutation_core(
+            u_ref[...], p_ref[...], di_ref[...],
+            lb_ref[...], ub_ref[...], rate_ref[...],
+        )
+
+    dt = parents.dtype
+    ops = _broadcast_operands(u.shape, dt, di, xlb, xub, mutation_rate)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(u.shape, dt),
+        interpret=jax.default_backend() != "tpu",
+    )(u, parents, *ops)
+
+
+def _sbx_pallas(u, parents1, parents2, di, xlb, xub):
+    from jax.experimental import pallas as pl
+
+    def kernel(u_ref, p1_ref, p2_ref, di_ref, lb_ref, ub_ref,
+               c1_ref, c2_ref):
+        c1, c2 = _sbx_core(
+            u_ref[...], p1_ref[...], p2_ref[...],
+            di_ref[...], lb_ref[...], ub_ref[...],
+        )
+        c1_ref[...] = c1
+        c2_ref[...] = c2
+
+    dt = parents1.dtype
+    ops = _broadcast_operands(u.shape, dt, di, xlb, xub)
+    out = jax.ShapeDtypeStruct(u.shape, dt)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(out, out),
+        interpret=jax.default_backend() != "tpu",
+    )(u, parents1, parents2, *ops)
 
 
 def polynomial_mutation(
@@ -31,11 +128,9 @@ def polynomial_mutation(
     B, n = parents.shape
     di = jnp.broadcast_to(jnp.asarray(di_mutation, parents.dtype), (n,))
     u = jax.random.uniform(key, (B, n), dtype=parents.dtype)
-    pw = 1.0 / (di + 1.0)
-    delta_lo = (2.0 * u) ** pw - 1.0
-    delta_hi = 1.0 - (2.0 * (1.0 - u)) ** pw
-    delta = jnp.where(u < mutation_rate, delta_lo, delta_hi)
-    return jnp.clip(parents + (xub - xlb) * delta, xlb, xub)
+    if _pallas_route():
+        return _mutation_pallas(u, parents, di, xlb, xub, mutation_rate)
+    return _mutation_core(u, parents, di, xlb, xub, mutation_rate)
 
 
 def sbx_crossover(
@@ -55,15 +150,9 @@ def sbx_crossover(
     B, n = parents1.shape
     di = jnp.broadcast_to(jnp.asarray(di_crossover, parents1.dtype), (n,))
     u = jax.random.uniform(key, (B, n), dtype=parents1.dtype)
-    pw = 1.0 / (di + 1.0)
-    beta = jnp.where(
-        u <= 0.5,
-        (2.0 * u) ** pw,
-        (1.0 / (2.0 * (1.0 - u))) ** pw,
-    )
-    c1 = 0.5 * ((1.0 - beta) * parents1 + (1.0 + beta) * parents2)
-    c2 = 0.5 * ((1.0 + beta) * parents1 + (1.0 - beta) * parents2)
-    return jnp.clip(c1, xlb, xub), jnp.clip(c2, xlb, xub)
+    if _pallas_route():
+        return _sbx_pallas(u, parents1, parents2, di, xlb, xub)
+    return _sbx_core(u, parents1, parents2, di, xlb, xub)
 
 
 def tournament_probabilities(n: int, p: float = 0.5) -> jax.Array:

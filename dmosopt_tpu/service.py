@@ -251,8 +251,20 @@ class OptimizationService:
         exporter=None,
         owner: Optional[str] = None,
         placement_epoch: int = 0,
+        scheduler=None,
     ):
         self.min_bucket = int(min_bucket)
+        # async task-graph epochs (docs/parallel.md "Async task-graph
+        # epochs"): ``scheduler`` is None/False (the lockstep step,
+        # default), True (auto worker count), an int concurrency
+        # (1 = serial graph, the bitwise-parity mode), or a dict with a
+        # ``concurrency`` key. When enabled, `step()` routes to
+        # `_step_taskgraph`, which expresses the epoch as a per-tenant/
+        # per-bucket task DAG executed by `parallel.taskgraph.TaskGraph`.
+        from dmosopt_tpu.parallel.taskgraph import resolve_concurrency
+
+        self.scheduler_concurrency = resolve_concurrency(scheduler)
+        self._last_graph: Dict[str, Any] = {}
         # ownership lease (fleet migration wire format): `owner` names
         # the worker process whose checkpoints these are; the
         # supervisor's monotonically increasing `placement_epoch` is
@@ -872,9 +884,16 @@ class OptimizationService:
         ``eval`` / ``fit`` (the batched bucket advance, surrogate fit +
         inner EA) / ``fold`` (result installation + front streaming) —
         each observed into ``service_step_seconds{phase=}`` and, with
-        tracing enabled, nested under one ``epoch`` span."""
+        tracing enabled, nested under one ``epoch`` span.
+
+        With the task-graph scheduler enabled (``scheduler=`` knob) the
+        same epoch runs as a task DAG instead — see `_step_taskgraph`;
+        a scheduler concurrency of 1 executes the identical lockstep
+        sequence and is bitwise-equal to this path."""
         if self._closed:
             raise RuntimeError("service is closed")
+        if self.scheduler_concurrency:
+            return self._step_taskgraph()
         from dmosopt_tpu.tenants import initialize_epochs_batched
         from dmosopt_tpu.datatypes import StrategyState
 
@@ -981,6 +1000,296 @@ class OptimizationService:
                 time.perf_counter() - t0,
                 phase="service_step",
             )
+        self._finish_step(t0, phases, n_advanced)
+        return n_advanced
+
+    def _on_init_error(self, tid, e: BaseException):
+        """Per-tenant epoch-init failure containment for graph bucket
+        nodes: fail THAT tenant if it is still active (a concurrent
+        eval-branch failure may already have retired it)."""
+        with self._lock:
+            t = self._active.get(tid)
+        if t is not None:
+            self._fail_tenant(t, e)
+
+    def _step_taskgraph(self) -> int:
+        """One epoch boundary as a task DAG (docs/parallel.md "Async
+        task-graph epochs"): a ``dispatch`` node submits every tenant's
+        pending evaluation batch, per-tenant ``eval`` nodes drain and
+        fold results under each tenant's fault policy, per-provisional-
+        bucket ``bucket`` nodes (grouped by `static_bucket_signature`,
+        which needs no archive) and per-ineligible-tenant ``seq`` nodes
+        run `initialize_epochs_batched` on their subset, per-tenant
+        ``fold`` nodes install epochs and stream fronts through the
+        BackgroundWriter, and a ``checkpoint`` node closes the step.
+
+        A bucket node only waits on ITS members' eval nodes, so bucket
+        B's fit/EA program launches while bucket A's host-side evals
+        are still draining — the overlap the lockstep barrier forbids.
+        Failures degrade per branch: a failed eval retires its tenant
+        inside the eval node (never raising), so sibling branches keep
+        running. At ``scheduler_concurrency == 1`` the nodes execute in
+        creation order on the calling thread, which is exactly the
+        lockstep sequence — bitwise parity with `step()`."""
+        from dmosopt_tpu.tenants import (
+            initialize_epochs_batched,
+            static_bucket_signature,
+        )
+        from dmosopt_tpu.datatypes import StrategyState
+        from dmosopt_tpu.parallel.taskgraph import DONE, FAILED, TaskGraph
+
+        t0 = time.perf_counter()
+        phases: Dict[str, float] = {}
+        trace_ctx = (
+            self.telemetry.device_capture(self._steps_run)
+            if self.telemetry and self.telemetry.should_trace(self._steps_run)
+            else contextlib.nullcontext(None)
+        )
+        run = None
+        with trace_ctx, span_scope(self.telemetry, "epoch", step=self._steps_run):
+            with self._step_phase(phases, "admit"), span_scope(
+                self.telemetry, "admit"
+            ):
+                self._admit_pending()
+            if not self._active:
+                self._finish_step(t0, phases, 0)
+                return 0
+            # fold nodes run concurrently: create the writer up front so
+            # the lazy `_submit_write` init cannot race
+            if self._writer is None:
+                self._writer = BackgroundWriter(telemetry=self.telemetry)
+
+            tenants = list(self._active.items())
+            graph = TaskGraph(f"step{self._steps_run}")
+            inflight: Dict[int, Tuple] = {}
+
+            def dispatch():
+                with span_scope(self.telemetry, "eval_dispatch"):
+                    for tid, t in tenants:
+                        task_args, task_reqs = self._gather_tenant_rounds(t)
+                        if not task_args:
+                            continue
+                        pol = t.policy or EvalPolicy()
+                        if hasattr(t.evaluator, "submit_batch"):
+                            handle = t.evaluator.submit_batch(
+                                task_args,
+                                timeout=self._effective_timeout(t),
+                                retries=pol.retries,
+                                backoff=pol.backoff,
+                                backoff_cap=pol.backoff_cap,
+                            )
+                        else:
+                            handle = None
+                        inflight[tid] = (handle, task_args, task_reqs)
+
+            dispatch_node = graph.add("dispatch", dispatch, kind="dispatch")
+
+            def make_eval(tid, t):
+                def eval_node():
+                    entry = inflight.get(tid)
+                    if entry is None:
+                        return 0
+                    handle, task_args, task_reqs = entry
+                    results, fatal = self._collect_results(
+                        t, handle, task_args
+                    )
+                    if fatal is not None:
+                        self._fail_tenant(t, fatal)
+                        return 0
+                    return self._fold_tenant_results(t, results, task_reqs)
+
+                return eval_node
+
+            eval_nodes: Dict[int, Any] = {}
+            for tid, t in tenants:
+                eval_nodes[tid] = graph.add(
+                    f"eval:{t.handle.opt_id}", make_eval(tid, t),
+                    deps=[dispatch_node], kind="eval",
+                    tenant=t.handle.opt_id,
+                )
+
+            # provisional grouping by STATIC bucket signature (no
+            # archive needed): members whose archive disqualifies them
+            # are re-routed sequential by the full eligibility recheck
+            # inside `initialize_epochs_batched`, reproducing lockstep
+            # bucket membership exactly
+            group_members: Dict[Any, List[int]] = {}
+            for tid, t in tenants:
+                sig = static_bucket_signature(t.strat)
+                key = sig if sig is not None else ("__seq__", tid)
+                group_members.setdefault(key, []).append(tid)
+
+            def make_group(tids):
+                def group_node():
+                    strategies, epochs = {}, {}
+                    with self._lock:
+                        members = [
+                            (tid, self._active.get(tid)) for tid in tids
+                        ]
+                    for tid, t in members:
+                        if t is None:
+                            continue  # retired by its eval branch
+                        if t.strat.x is None and not t.strat.has_completed():
+                            # no archive ever landed: nothing to fit on;
+                            # re-issue/retirement is the eval fold's job
+                            continue
+                        strategies[tid] = t.strat
+                        epochs[tid] = t.epochs_run
+                    if strategies:
+                        initialize_epochs_batched(
+                            strategies, epochs, min_bucket=self.min_bucket,
+                            telemetry=self.telemetry, logger=self.logger,
+                            on_error=self._on_init_error,
+                        )
+                    return frozenset(strategies)
+
+                return group_node
+
+            group_nodes: Dict[int, Any] = {}  # tid -> its group node
+            member_tids: Dict[int, List[int]] = {}  # node seq -> members
+            for key, tids in group_members.items():
+                kind = "seq" if key[0] == "__seq__" else "bucket"
+                first = self._active[tids[0]]
+                name = (
+                    f"seq:{first.handle.opt_id}" if kind == "seq"
+                    else f"bucket:{key[0]}_d{key[1]}_o{key[2]}_p{key[3]}"
+                )
+                node = graph.add(
+                    name, make_group(tids),
+                    deps=[eval_nodes[tid] for tid in tids], kind=kind,
+                    tenant=first.handle.opt_id if kind == "seq" else None,
+                )
+                member_tids[node.seq] = list(tids)
+                for tid in tids:
+                    group_nodes[tid] = node
+
+            def make_fold(tid, t, group):
+                def fold_node():
+                    advanced = group.result or frozenset()
+                    if tid not in advanced:
+                        return False
+                    with self._lock:
+                        live = self._active.get(tid)
+                    if live is None:
+                        return False
+                    try:
+                        resample = (t.epochs_run + 1) < t.n_epochs
+                        state, _res, _evals = t.strat.update_epoch(
+                            resample=resample
+                        )
+                        if state != StrategyState.CompletedEpoch:
+                            raise RuntimeError(
+                                f"tenant {t.handle.opt_id!r}: epoch did "
+                                f"not complete in one update (state "
+                                f"{state}); the service requires "
+                                f"surrogate-mode tenants"
+                            )
+                        epoch = t.epochs_run
+                        t.epochs_run += 1
+                        self._absorb_tenant_costs(t)
+                        self._stream_front(t, epoch)
+                    except Exception as e:
+                        self._fail_tenant(t, e)
+                        return False
+                    if t.epochs_run >= t.n_epochs:
+                        t.handle.done = True
+                        self._retire(t, "completed")
+                        if t.owns_evaluator and hasattr(t.evaluator, "close"):
+                            t.evaluator.close()
+                        if self.telemetry:
+                            self.telemetry.inc("tenants_completed_total")
+                    return True
+
+                return fold_node
+
+            fold_nodes = []
+            for tid, t in tenants:
+                fold_nodes.append(
+                    graph.add(
+                        f"fold:{t.handle.opt_id}",
+                        make_fold(tid, t, group_nodes[tid]),
+                        deps=[group_nodes[tid]], kind="fold",
+                        tenant=t.handle.opt_id,
+                    )
+                )
+
+            def checkpoint_node():
+                self._checkpoint()
+                self._flush_writer()
+
+            ckpt = graph.add(
+                "checkpoint", checkpoint_node, deps=fold_nodes,
+                kind="checkpoint",
+            )
+
+            run = graph.run(
+                concurrency=self.scheduler_concurrency,
+                telemetry=self.telemetry, logger=self.logger,
+            )
+
+            # a failed bucket/seq node (an exception even the batched
+            # core's sequential fallback could not contain) fails its
+            # still-active members — per-branch degradation, never a
+            # half-stepped tenant
+            for node in run.failed:
+                for tid in member_tids.get(node.seq, ()):
+                    self._on_init_error(tid, node.error)
+            if ckpt.state != DONE:
+                # the checkpoint must happen even when a failed branch
+                # skipped its node (every boundary durable — the
+                # lockstep contract)
+                self._checkpoint()
+                self._flush_writer()
+            if dispatch_node.state == FAILED:
+                # lockstep parity: a dispatch-time failure (broken
+                # evaluator plumbing) raises out of step()
+                raise dispatch_node.error
+
+            n_advanced = sum(
+                len(n.result)
+                for n in run.nodes
+                if n.kind in ("bucket", "seq") and n.state == DONE and n.result
+            )
+            # per-phase extents from node timestamps (the lockstep
+            # phases, derived instead of measured around barriers)
+            for phase, kinds in (
+                ("eval", ("dispatch", "eval")),
+                ("fit", ("bucket", "seq")),
+                ("fold", ("fold",)),
+            ):
+                starts = [
+                    n.t_start for n in run.nodes
+                    if n.kind in kinds and n.t_start is not None
+                ]
+                ends = [
+                    n.t_end for n in run.nodes
+                    if n.kind in kinds and n.t_end is not None
+                ]
+                if starts and ends:
+                    phases[phase] = max(ends) - min(starts)
+                    if self.telemetry:
+                        self.telemetry.observe(
+                            "service_step_seconds", phases[phase],
+                            phase=phase,
+                        )
+        if self.telemetry:
+            self.telemetry.inc("service_epochs_total")
+            self.telemetry.gauge("tenants_active", len(self._active))
+            self.telemetry.observe(
+                "phase_duration_seconds",
+                time.perf_counter() - t0,
+                phase="service_step",
+            )
+            ledger = self.telemetry.ledger
+            if ledger is not None and ledger.last_capture is not None:
+                # device truth for the scheduler-stall rule: seconds the
+                # device sat idle inside the last profiled capture
+                cap = ledger.last_capture
+                self.telemetry.gauge(
+                    "scheduler_device_idle_gap_seconds",
+                    max(cap.window_s - cap.device_busy_s, 0.0),
+                )
+        self._last_graph = run.to_dict() if run is not None else {}
         self._finish_step(t0, phases, n_advanced)
         return n_advanced
 
@@ -1530,6 +1839,15 @@ class OptimizationService:
             # span-buffer pressure: evictions past `trace_max_spans` —
             # invisible outside this dict before the device-truth PR
             snap["spans_dropped"] = self.telemetry.tracer.spans_dropped
+        if self.scheduler_concurrency:
+            # task-graph scheduler state (docs/parallel.md "Async
+            # task-graph epochs"): last step's per-node states and
+            # wait/run seconds — the host-side view the scheduler_*
+            # metrics aggregate
+            snap["scheduler"] = {
+                "concurrency": self.scheduler_concurrency,
+                "last_graph": dict(self._last_graph),
+            }
         ledger = self.telemetry.ledger if self.telemetry else None
         if ledger is not None and ledger.has_data:
             # device truth (profiled steps only): per-program device

@@ -164,16 +164,39 @@ class Telemetry:
         mark = self.tracer.mark() if self.tracer is not None else 0
         t_start = time.time()
         started = False
+        session = None
+        # prefer a raw ProfilerSession with the PYTHON tracer disabled:
+        # the default python tracer floods the capture with hundreds of
+        # thousands of call events on large steps (T=64 services), which
+        # both distorts the step's wall and evicts the TraceAnnotation
+        # host events the ledger joins on — concurrent scheduler bucket
+        # windows were observably dropped from the trace under it
         try:
-            jax.profiler.start_trace(self.profile_dir)
+            from jax._src.lib import xla_client
+
+            opts = xla_client.profiler.ProfileOptions()
+            opts.python_tracer_level = 0
+            session = xla_client.profiler.ProfilerSession(opts)
             started = True
-            self.event("trace", epoch=epoch, profile_dir=self.profile_dir)
         except Exception:
-            pass  # a profiler that refuses to start must not kill the epoch
+            session = None
+        if session is None:
+            try:
+                jax.profiler.start_trace(self.profile_dir)
+                started = True
+            except Exception:
+                pass  # a refusing profiler must not kill the epoch
+        if started:
+            self.event("trace", epoch=epoch, profile_dir=self.profile_dir)
         try:
             yield self.ledger
         finally:
-            if started:
+            if session is not None:
+                try:
+                    session.stop_and_export(str(self.profile_dir))
+                except Exception:
+                    started = False
+            elif started:
                 try:
                     jax.profiler.stop_trace()
                 except Exception:

@@ -137,6 +137,62 @@ def _clipped_total(
     return out
 
 
+def _spans_overlap(spans) -> bool:
+    """True when any two spans (sorted by t_start) overlap in host time
+    — the signature of concurrent same-name scheduler nodes."""
+    prev_end = None
+    for s in spans:
+        if prev_end is not None and s.t_start < prev_end:
+            return True
+        end = s.t_end if s.t_end is not None else s.t_start
+        prev_end = end if prev_end is None else max(prev_end, end)
+    return False
+
+
+def _assign_windows(
+    name_spans, windows: List[Tuple[float, float]]
+) -> List[Optional[int]]:
+    """Map each same-name host span (sorted by start) to the index of
+    its annotation window, or None when unjoined.
+
+    Serial spans — no host-time overlap, the lockstep/concurrency-1 case
+    — keep the exact rank join with tail alignment: the k-th surviving
+    span matches the k-th most-recent window. Under the concurrent
+    task-graph scheduler, same-name spans from different worker threads
+    can overlap, and their host-clock start order no longer predicts the
+    trace-clock window order (the profiler orders windows by device
+    enqueue); rank-joining would cross-wire device time between
+    tenants' buckets. Overlapping spans instead greedily match each
+    span (longest first) to the unused window whose duration is closest
+    to the span's own — concurrent same-name spans carry distinct
+    workloads, hence measurably distinct durations."""
+    n_s, n_w = len(name_spans), len(windows)
+    if not _spans_overlap(name_spans):
+        offset = max(n_w - n_s, 0)
+        return [
+            (i + offset) if (i + offset) < n_w else None
+            for i in range(n_s)
+        ]
+    assigned: List[Optional[int]] = [None] * n_s
+    used = set()
+    order = sorted(
+        range(n_s), key=lambda i: -(name_spans[i].duration_s or 0.0)
+    )
+    for i in order:
+        dur = name_spans[i].duration_s or 0.0
+        best, best_diff = None, None
+        for j in range(n_w):
+            if j in used:
+                continue
+            diff = abs((windows[j][1] - windows[j][0]) - dur)
+            if best_diff is None or diff < best_diff:
+                best, best_diff = j, diff
+        if best is not None:
+            used.add(best)
+            assigned[i] = best
+    return assigned
+
+
 # ------------------------------------------------------------ trace parse
 
 
@@ -389,10 +445,13 @@ class DeviceLedger:
 
         `host_spans`: the CLOSED `telemetry.tracing.Span`s opened while
         the capture ran (the caller brackets the capture with
-        `Tracer.mark` / `spans_since`). Joining is per span name, in
-        time order — the k-th host span named N matches the k-th trace
-        annotation named N, because every `Tracer.span` entered exactly
-        one same-named `TraceAnnotation` in open order. Device time
+        `Tracer.mark` / `spans_since`). Joining is per span name: when
+        same-name spans are serial, the k-th host span named N matches
+        the k-th trace annotation named N, because every `Tracer.span`
+        entered exactly one same-named `TraceAnnotation` in open order;
+        when they overlap (concurrent task-graph scheduler nodes),
+        windows are matched by duration similarity instead
+        (`_assign_windows`). Device time
         charged to a span is the device-lane busy union clipped to its
         annotation window; `tenant_cost` child spans split their
         parent's device seconds by their host-share weights (the same
@@ -426,21 +485,20 @@ class DeviceLedger:
         with self._lock:
             for name, name_spans in by_name.items():
                 windows = parsed.annotations.get(name, [])
-                # eviction alignment: the span buffer drops its OLDEST
-                # spans, so when the trace holds more annotation windows
-                # than surviving spans, the survivors correspond to the
-                # most RECENT windows — align to the tail, or the k-th
-                # survivor would silently join an earlier span's window
-                offset = max(len(windows) - len(name_spans), 0)
+                # serial spans rank-join with eviction tail alignment
+                # (the span buffer drops oldest-first); overlapping
+                # spans — concurrent scheduler nodes — match windows by
+                # duration similarity instead, see _assign_windows
+                assign = _assign_windows(name_spans, windows)
                 for i, sp in enumerate(name_spans):
                     bucket = (sp.labels or {}).get("bucket")
                     row = self._row_locked(name, bucket)
                     row.n_spans += 1
                     cap.n_spans += 1
                     row.host_time_s += sp.duration_s or 0.0
-                    if i + offset >= len(windows):
+                    if assign[i] is None:
                         continue
-                    a0, a1 = windows[i + offset]
+                    a0, a1 = windows[assign[i]]
                     dev_s = _clipped_total(busy, a0, a1)
                     row.n_joined += 1
                     cap.n_joined += 1

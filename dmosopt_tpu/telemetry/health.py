@@ -262,6 +262,19 @@ def default_rulebook(include_host: bool = True) -> List[HealthRule]:
             ),
         ),
         HealthRule(
+            name="scheduler_stall",
+            metric="gauge:scheduler_stall_seconds",
+            threshold=1.0, compare=">", for_steps=2, severity="warning",
+            description=(
+                "a device-launching task-graph node (bucket/seq) sat "
+                "READY for over a second on consecutive steps while "
+                "workers were busy elsewhere — ready nodes but an idle "
+                "device; raise the scheduler concurrency or check for "
+                "a host-bound eval hogging the pool (docs/parallel.md "
+                "'Async task-graph epochs')"
+            ),
+        ),
+        HealthRule(
             name="series_overflow",
             metric="counter:telemetry_series_overflow_total",
             threshold=0.0, compare=">", mode="delta", severity="warning",

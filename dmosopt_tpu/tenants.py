@@ -39,6 +39,7 @@ path exactly; only batched-kernel reduction order differs.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 from dataclasses import dataclass, field
 from types import SimpleNamespace
@@ -100,9 +101,24 @@ def bucket_label(dim: int, n_obj: int, pop: int) -> str:
 
 def batch_eligibility(strat) -> Optional[str]:
     """None when `strat` can join a bucket this epoch; otherwise a short
-    reason string (diagnostics + telemetry)."""
+    reason string (diagnostics + telemetry). The full check = the two
+    archive-dependent gates (empty archive, dense-kernel threshold)
+    around `_static_eligibility`'s configuration-only gates."""
     if strat.x is None:
         return "empty archive"
+    reason = _static_eligibility(strat)
+    if reason is not None:
+        return reason
+    kwargs = strat.surrogate_method_kwargs or {}
+    threshold = kwargs.get("large_n_threshold", LARGE_N_THRESHOLD)
+    if threshold and strat.x.shape[0] > threshold:
+        return "archive beyond dense-kernel threshold"
+    return None
+
+
+def _static_eligibility(strat) -> Optional[str]:
+    """The archive-INDEPENDENT part of `batch_eligibility`: every gate
+    decidable from the tenant's static configuration alone."""
     if len(strat.optimizer_name) != 1:
         return "cycled optimizers"
     name = strat.optimizer_name[0]
@@ -141,10 +157,28 @@ def batch_eligibility(strat) -> Optional[str]:
         return "adaptive population size"
     if "distance_metric" in okw:
         return "distance metric override"
-    threshold = kwargs.get("large_n_threshold", LARGE_N_THRESHOLD)
-    if threshold and strat.x.shape[0] > threshold:
-        return "archive beyond dense-kernel threshold"
     return None
+
+
+def static_bucket_signature(strat) -> Optional[Tuple]:
+    """The tenant's bucket signature from static configuration alone,
+    or None when the static gates already rule the tenant out.
+
+    `bucket_signature` depends only on static config (shapes, fit
+    config, optimizer kwargs — never the archive), so statically
+    eligible tenants can be grouped into PROVISIONAL buckets before
+    their evaluations drain: the task-graph service step uses this to
+    build one bucket node per group, and the full `batch_eligibility`
+    recheck inside `initialize_epochs_batched` (pass 1) re-routes any
+    member whose ARCHIVE disqualifies it (still empty, or past the
+    dense-kernel threshold) to the sequential path — reproducing
+    lockstep bucket membership exactly, since the archive gates are
+    the only checks this signature skips."""
+    if _static_eligibility(strat) is not None:
+        return None
+    return bucket_signature(
+        strat, strat.optimizer_name[0], strat.optimizer_kwargs[0]
+    )
 
 
 def _fit_config(strat) -> Dict[str, Any]:
@@ -359,6 +393,14 @@ def _slice_tree(tree, i):
 # the cache exists to prevent.
 _PROGRAM_CACHE: Dict[Tuple, "_BucketProgram"] = {}
 _PROGRAM_CACHE_MAX = 64
+# guards the cache dict itself (lookup/insert/evict): the task-graph
+# scheduler runs DIFFERENT buckets' epochs from concurrent nodes, and a
+# concurrent insert+evict on a plain dict can drop a just-inserted
+# program. Distinct buckets have distinct (sig, T) keys, so per-program
+# state (`_BucketProgram.executables`) stays single-threaded; only the
+# shared dict needs the lock, and nothing blocking runs under it —
+# tracing/compiling happens outside.
+_PROGRAM_CACHE_LOCK = threading.Lock()
 
 
 class _BucketProgram:
@@ -380,11 +422,12 @@ def _sig_label(sig: Tuple) -> str:
 
 def _bucket_program(sig: Tuple, optimizer, kernel: str, T: int) -> "_BucketProgram":
     key = (sig, T)
-    prog = _PROGRAM_CACHE.get(key)
-    if prog is not None:
-        return prog
-    while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
-        _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+    with _PROGRAM_CACHE_LOCK:
+        prog = _PROGRAM_CACHE.get(key)
+        if prog is not None:
+            return prog
+        while len(_PROGRAM_CACHE) >= _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
 
     @jax.jit
     def run_chunk(fit, xlb, xrg, states, keys, active):  # graftlint: disable=retrace-hazard -- cached in _PROGRAM_CACHE keyed by (bucket signature, T); the closure holds only static bucket config, all per-epoch state is arguments
@@ -417,7 +460,15 @@ def _bucket_program(sig: Tuple, optimizer, kernel: str, T: int) -> "_BucketProgr
         return jax.lax.scan(step, states, (keys, active))
 
     prog = _BucketProgram(run_chunk)
-    _PROGRAM_CACHE[key] = prog
+    with _PROGRAM_CACHE_LOCK:
+        # first writer wins on a racing double-build of the same key:
+        # both closures trace identical programs, so returning the
+        # existing entry keeps the retrace detector's bookkeeping on
+        # one object
+        existing = _PROGRAM_CACHE.get(key)
+        if existing is not None:
+            return existing
+        _PROGRAM_CACHE[key] = prog
     return prog
 
 

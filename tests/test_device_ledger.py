@@ -368,3 +368,146 @@ def test_status_cli_renders_device_ledger(profiled_service, tmp_path):
     assert "program ea_scan" in out
     assert "tenant device seconds:" in out
     assert "spans_dropped=0" in out
+
+
+# ------------------------------------------- concurrent-scheduler joins
+
+
+def test_ledger_overlapping_spans_join_by_duration():
+    """ISSUE 19: under the task-graph scheduler, same-name spans from
+    concurrent worker threads overlap in host time, and host start
+    order no longer predicts trace window order. The join must match
+    windows by duration similarity, not rank — otherwise device time
+    cross-wires between buckets."""
+    trace = {
+        "traceEvents": [
+            _meta(1, pname="/host:CPU"),
+            _meta(1, tid=10, tname="python"),
+            _meta(7, pname="/device:TPU:0"),
+            _meta(7, tid=1, tname="lane-0"),
+            _x(1, 10, "gp_fit", 0, 100),   # window A: 100us
+            _x(1, 10, "gp_fit", 200, 30),  # window B: 30us
+            _x(7, 1, "op.1", 10, 50),      # 50us busy inside A
+            _x(7, 1, "op.2", 205, 10),     # 10us busy inside B
+        ]
+    }
+    led = DeviceLedger()
+    # the SHORT span starts first on the host clock (rank join would
+    # hand it window A); both overlap — concurrent scheduler nodes
+    spans = [
+        _span("gp_fit", 1, 50.0, 50.0 + 30e-6, bucket="b_small"),
+        _span("gp_fit", 2, 50.0 + 10e-6, 50.0 + 110e-6, bucket="b_big"),
+    ]
+    cap = led.ingest_chrome_trace(trace, spans)
+    rows = {(r.program, r.bucket): r for r in led.program_rows()}
+    # duration match: the 100us span owns window A's 50us of device
+    # time, the 30us span owns window B's 10us
+    assert rows[("gp_fit", "b_big")].device_time_s == pytest.approx(50e-6)
+    assert rows[("gp_fit", "b_small")].device_time_s == pytest.approx(10e-6)
+    assert cap.join_fraction == 1.0
+
+
+def test_ledger_overlapping_spans_attribution_stays_exact():
+    """Tenant attribution under the duration join: every joined
+    window's device seconds split by the host-share weights, and the
+    total attributed equals the total joined device time exactly."""
+    trace = {
+        "traceEvents": [
+            _meta(1, pname="/host:CPU"),
+            _meta(1, tid=10, tname="python"),
+            _meta(7, pname="/device:TPU:0"),
+            _meta(7, tid=1, tname="lane-0"),
+            _x(1, 10, "gp_fit", 0, 100),
+            _x(1, 10, "gp_fit", 200, 30),
+            _x(7, 1, "op.1", 10, 50),
+            _x(7, 1, "op.2", 205, 10),
+        ]
+    }
+    led = DeviceLedger()
+    spans = [
+        _span("gp_fit", 1, 50.0, 50.0 + 30e-6, bucket="b_small"),
+        _span("tenant_cost", 2, 50.0, 50.0 + 30e-6, parent=1,
+              tenant="c", phase="fit"),
+        _span("gp_fit", 3, 50.0 + 10e-6, 50.0 + 110e-6, bucket="b_big"),
+        _span("tenant_cost", 4, 50.0 + 10e-6, 50.0 + 70e-6, parent=3,
+              tenant="a", phase="fit"),
+        _span("tenant_cost", 5, 50.0 + 70e-6, 50.0 + 110e-6, parent=3,
+              tenant="b", phase="fit"),
+    ]
+    led.ingest_chrome_trace(trace, spans)
+    tds = led.tenant_device_seconds()
+    # b_big's 50us splits 60/40 across a/b; b_small's 10us all to c
+    assert tds["a"]["fit"] == pytest.approx(50e-6 * 0.6, rel=1e-6)
+    assert tds["b"]["fit"] == pytest.approx(50e-6 * 0.4, rel=1e-6)
+    assert tds["c"]["fit"] == pytest.approx(10e-6, rel=1e-6)
+    total = sum(sum(p.values()) for p in tds.values())
+    assert total == pytest.approx(60e-6, rel=1e-6)
+
+
+@pytest.fixture(scope="module")
+def profiled_scheduler_service(tmp_path_factory):
+    """The ISSUE-19 acceptance workload: the same profiled 2-bucket,
+    3-tenant service, stepped by the task-graph scheduler (concurrency
+    3) so bucket/seq nodes run on worker threads and their gp_fit /
+    ea_scan spans can overlap during the profiled step."""
+    prof_dir = str(tmp_path_factory.mktemp("prof_sched"))
+    svc = OptimizationService(
+        min_bucket=1,
+        scheduler=3,
+        telemetry={"profile_dir": prof_dir, "profile_epochs": [1]},
+    )
+
+    def submit(dim, seed, n_epochs):
+        return svc.submit(
+            zdt1,
+            {f"x{i}": [0.0, 1.0] for i in range(dim)},
+            ["f1", "f2"],
+            n_epochs=n_epochs,
+            population_size=16,
+            num_generations=4,
+            n_initial=3,
+            surrogate_method_kwargs=dict(SMK),
+            random_seed=seed,
+        )
+
+    submit(4, 1, 3)
+    submit(4, 2, 3)
+    submit(6, 3, 3)
+    svc.run()
+    snap = svc.introspect()
+    yield svc, snap
+    svc.close()
+
+
+def test_scheduler_profiled_ledger_joins_and_attribution_sum(
+    profiled_scheduler_service,
+):
+    """Re-pin the ISSUE-12 device-truth gates with the scheduler
+    enabled (out-of-order node completion): gp_fit/ea_scan spans still
+    join >= 90%, and the tenant_device_seconds counter total still
+    equals the ledger's attributed total exactly."""
+    svc, snap = profiled_scheduler_service
+    dl = snap.get("device_ledger")
+    assert dl is not None, "profiled scheduler step produced no ledger"
+    assert dl["captures"] >= 1
+    fit_ea = [
+        r for r in dl["programs"] if r["program"] in ("gp_fit", "ea_scan")
+    ]
+    assert fit_ea
+    n_spans = sum(r["n_spans"] for r in fit_ea)
+    n_joined = sum(r["n_joined"] for r in fit_ea)
+    assert n_spans > 0
+    assert n_joined / n_spans >= 0.9, (n_joined, n_spans)
+    assert sum(r["device_time_s"] for r in fit_ea) > 0
+    # the attribution-sum gate, scheduler-enabled
+    tds = dl.get("tenant_device_seconds")
+    assert tds and len(tds) == 3
+    counters = svc.telemetry.registry.snapshot()["counters"].get(
+        "tenant_device_seconds", {}
+    )
+    assert counters, "tenant_device_seconds counter never incremented"
+    assert sum(counters.values()) == pytest.approx(
+        sum(sum(p.values()) for p in tds.values()), rel=1e-6
+    )
+    # the step that profiled ran through the task graph
+    assert snap.get("scheduler", {}).get("last_graph", {}).get("nodes")

@@ -307,3 +307,105 @@ def test_lazy_host_array_supports_operators():
     assert (lazy > 2.5).sum() == 2
     np.testing.assert_allclose(lazy / 2.0, [[0.5, 1.0], [1.5, 2.0]])
     assert lazy.shape == (2, 2) and lazy.ndim == 2
+
+
+def test_fused_maxgen_path_bitwise_matches_chunked_oracle(monkeypatch):
+    """ISSUE 19 fused sequential path: under a plain
+    MaximumGenerationTermination the whole generation budget runs as
+    ONE scanned program. The retained chunk-per-host-check loop is the
+    parity oracle — trajectories must match bitwise — and with
+    telemetry the fused run compiles exactly one `ea_scan` program per
+    (signature, budget)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmosopt_tpu.models import Model
+    from dmosopt_tpu.models.gp import GPR_Matern
+    from dmosopt_tpu.optimizers.nsga2 import NSGA2
+    from dmosopt_tpu.telemetry import create_telemetry
+    from dmosopt_tpu.termination import MaximumGenerationTermination
+
+    dim, pop = 4, 16
+    rng = np.random.default_rng(0)
+    X = rng.uniform(size=(32, dim)).astype(np.float32)
+    Y = np.asarray(zdt1(jnp.asarray(X)))
+    sm = GPR_Matern(X, Y, dim, 2, np.zeros(dim), np.ones(dim),
+                    seed=0, n_starts=2, n_iter=15)
+    eval_fn = moasmo._surrogate_eval_fn(Model(objective=sm))
+    bounds = np.stack([np.zeros(dim), np.ones(dim)], 1)
+
+    class Prob:
+        lb = np.zeros(dim)
+        ub = np.ones(dim)
+        logger = None
+
+    def run(fused, tel=None):
+        with monkeypatch.context() as mp:
+            if not fused:
+                # disable the fusion gate: the while loop below it IS
+                # the pre-fusion chunked implementation, unchanged
+                mp.setattr(moasmo, "_fused_generation_total",
+                           lambda *a: 0)
+            opt = NSGA2(popsize=pop, nInput=dim, nOutput=2, model=None)
+            opt.initialize_strategy(X[:pop], Y[:pop], bounds, random=0)
+            return moasmo._optimize_on_device(
+                opt, eval_fn, num_generations=6, key=jax.random.PRNGKey(0),
+                termination=MaximumGenerationTermination(Prob(), n_max_gen=6),
+                termination_check_interval=2, telemetry=tel,
+            )
+
+    xf, yf, gf = run(True)
+    xc, yc, gc = run(False)
+    # the chunked loop checks at gens 0,2,4,6 (continue while <= 6) and
+    # stops at 8 -> both paths run exactly 8 generations
+    assert len(gf) == len(gc) == 8
+    assert np.array_equal(gf, gc)
+    assert np.array_equal(xf, xc)
+    assert np.array_equal(yf, yc)
+
+    # trace-time pin: ONE compiled program for the whole budget (the
+    # chunked loop also compiles once but dispatches per chunk; the
+    # fused path must never fan back out into per-chunk shapes)
+    tel = create_telemetry(True)
+    run(True, tel)
+    compiles = [
+        e for e in tel.log.records(kind="program_compile")
+        if e.fields["program"] == "ea_scan"
+    ]
+    assert len(compiles) == 1
+    assert compiles[0].fields["retrace"] is False
+
+
+def test_fused_generation_total_gates():
+    """Fusion only fires for a plain finite MaximumGenerationTermination;
+    every data-dependent rule stays on the host-checked chunked loop."""
+    from dmosopt_tpu.termination import (
+        MaximumGenerationTermination,
+        MultiObjectiveToleranceTermination,
+    )
+
+    class Prob:
+        lb = np.zeros(2)
+        ub = np.ones(2)
+        logger = None
+
+    assert moasmo._fused_generation_total(
+        MaximumGenerationTermination(Prob(), n_max_gen=10), 10
+    ) == 20
+    assert moasmo._fused_generation_total(
+        MaximumGenerationTermination(Prob(), n_max_gen=9), 10
+    ) == 10
+    assert moasmo._fused_generation_total(
+        MaximumGenerationTermination(Prob(), n_max_gen=21), 10
+    ) == 30
+    # infinite cap, forced stop, and composite criteria never fuse
+    assert moasmo._fused_generation_total(
+        MaximumGenerationTermination(Prob(), n_max_gen=None), 10
+    ) == 0
+    forced = MaximumGenerationTermination(Prob(), n_max_gen=10)
+    forced.force_termination = True
+    assert moasmo._fused_generation_total(forced, 10) == 0
+    assert moasmo._fused_generation_total(
+        MultiObjectiveToleranceTermination(Prob(), n_max_gen=10), 10
+    ) == 0
+    assert moasmo._fused_generation_total(None, 10) == 0

@@ -479,3 +479,52 @@ def test_agemoea_survival_column_path_matches_dense(monkeypatch, rng):
         finite = np.isfinite(a)
         np.testing.assert_array_equal(finite, np.isfinite(b))
         np.testing.assert_allclose(a[finite], b[finite], rtol=1e-4, atol=1e-5)
+
+
+def test_variation_pallas_route_matches_dense(monkeypatch):
+    """The Pallas SBX/mutation kernels (ISSUE 19 tentpole residual) run
+    over PRECOMPUTED uniforms, so the route only changes how the
+    post-uniform math executes. Under jit — how the EA programs always
+    run these cores — the Pallas route (interpret mode off-TPU) must be
+    bitwise-equal to the frozen dense path; and with DMOSOPT_PALLAS
+    unset the CPU backend must keep routing dense."""
+    from dmosopt_tpu.ops import variation as V
+
+    monkeypatch.delenv("DMOSOPT_PALLAS", raising=False)
+    if jax.default_backend() != "tpu":
+        assert V._pallas_route() is False
+    monkeypatch.setenv("DMOSOPT_PALLAS", "0")
+    assert V._pallas_route() is False
+    monkeypatch.setenv("DMOSOPT_PALLAS", "1")
+    assert V._pallas_route() is True
+
+    B, n = 16, 5
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    p1 = jax.random.uniform(k1, (B, n))
+    p2 = jax.random.uniform(k2, (B, n))
+    xlb, xub = jnp.zeros(n), jnp.ones(n)
+    u = jax.random.uniform(k3, (B, n), dtype=p1.dtype)
+    di = jnp.broadcast_to(jnp.asarray(15.0, p1.dtype), (n,))
+
+    m_dense = np.asarray(
+        jax.jit(V._mutation_core)(u, p1, di, xlb, xub, 0.5)
+    )
+    m_pallas = np.asarray(V._mutation_pallas(u, p1, di, xlb, xub, 0.5))
+    np.testing.assert_array_equal(m_dense, m_pallas)
+
+    c1_d, c2_d = jax.jit(V._sbx_core)(u, p1, p2, di, xlb, xub)
+    c1_p, c2_p = V._sbx_pallas(u, p1, p2, di, xlb, xub)
+    np.testing.assert_array_equal(np.asarray(c1_d), np.asarray(c1_p))
+    np.testing.assert_array_equal(np.asarray(c2_d), np.asarray(c2_p))
+
+    # the public entry points honor the route and keep the same RNG
+    # draw (uniforms outside the kernel): same key -> same children
+    # within float tolerance across routes, exactly-equal in-bounds
+    key = jax.random.PRNGKey(7)
+    with_pallas = np.asarray(
+        V.polynomial_mutation(key, p1, 20.0, xlb, xub)
+    )
+    monkeypatch.setenv("DMOSOPT_PALLAS", "0")
+    dense = np.asarray(V.polynomial_mutation(key, p1, 20.0, xlb, xub))
+    np.testing.assert_allclose(with_pallas, dense, rtol=1e-6, atol=1e-7)
+    assert with_pallas.min() >= 0.0 and with_pallas.max() <= 1.0

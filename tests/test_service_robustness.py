@@ -417,3 +417,62 @@ def test_kill9_resume_subprocess(tmp_path):
         # final front quality: identical front, identical quality
         np.testing.assert_array_equal(h.best().y, ref_handles[k].best().y)
     svc.close()
+
+
+def test_chaos_scheduler_degrades_only_faulty_dag_branch(monkeypatch):
+    """ISSUE 19 fault-plan interaction: under the task-graph scheduler,
+    an eval node that raises or times out (EvalPolicy) degrades only
+    ITS tenant's DAG branch — sibling branches keep running and the
+    survivor's fronts stay bitwise-equal to a fault-free scheduler run
+    (itself bitwise-equal to lockstep)."""
+
+    def run(scheduler):
+        svc = OptimizationService(
+            telemetry=True, eval_policy=dict(POLICY), scheduler=scheduler
+        )
+        handles = {
+            name: _submit(svc, name, 4, seed=40 + i, n_epochs=2)
+            for i, name in enumerate(("good", "boom", "wedge"))
+        }
+        svc.run()
+        out = {k: _fronts(h) for k, h in handles.items()}
+        snap = svc.introspect()
+        svc.close()
+        return out, handles, snap
+
+    monkeypatch.delenv("DMOSOPT_FAULT_PLAN", raising=False)
+    ref_sched, _, _ = run(scheduler=3)
+    ref_lock, _, _ = run(scheduler=None)
+    # fault-free cross-check: the concurrent scheduler IS the lockstep
+    # trajectory (per-tenant RNG independence)
+    for k in ref_lock:
+        _assert_fronts_equal(ref_sched[k], ref_lock[k], who=f"sched {k}")
+
+    monkeypatch.setenv(
+        "DMOSOPT_FAULT_PLAN",
+        json.dumps(
+            {
+                "seed": 7,
+                "rules": [
+                    {"kind": "raise", "target": "boom"},
+                    {"kind": "hang", "target": "wedge", "delay_s": 0.6},
+                ],
+            }
+        ),
+    )
+    got, handles, snap = run(scheduler=3)
+
+    # faulty branches degraded + retired per policy, never an exception
+    # out of step(); causes travel on the handles
+    assert snap["tenant_counts"] == {"completed": 1, "degraded": 2}
+    for bad in ("boom", "wedge"):
+        assert handles[bad].done and handles[bad].error is not None
+
+    # the survivor's branch never saw the faults
+    assert handles["good"].error is None and handles["good"].done
+    _assert_fronts_equal(got["good"], ref_sched["good"], who="good")
+
+    # DAG-level containment: policy-degraded evals are handled INSIDE
+    # their eval node (no node failures, nothing skipped)
+    nodes = snap["scheduler"]["last_graph"]["nodes"]
+    assert nodes and all(n["state"] == "done" for n in nodes)

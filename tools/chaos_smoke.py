@@ -74,14 +74,15 @@ def _host_zdt1(dim):
     return f
 
 
-def _run_service(label):
+def _run_service(label, scheduler=None):
     import numpy as np
 
     from dmosopt_tpu.benchmarks.zdt import zdt1
     from dmosopt_tpu.service import OptimizationService
 
     svc = OptimizationService(
-        min_bucket=2, telemetry=True, eval_policy=dict(POLICY)
+        min_bucket=2, telemetry=True, eval_policy=dict(POLICY),
+        scheduler=scheduler,
     )
     handles = {}
 
@@ -206,14 +207,58 @@ def main() -> int:
     if not nan_finite:
         problems.append("t_nan archive/front contains non-finite rows")
 
+    # 4. task-graph scheduler leg (ISSUE 19): the same chaos plan under
+    # the concurrent scheduler must degrade ONLY the faulty tenants'
+    # DAG branches — survivors bitwise vs the fault-free run (which the
+    # scheduler reproduces bitwise, so one reference serves both legs)
+    os.environ["DMOSOPT_FAULT_PLAN"] = json.dumps(FAULT_PLAN)
+    try:
+        g_fronts, g_handles, g_snap, g_counters, _ = _run_service(
+            "chaos+scheduler", scheduler=3
+        )
+    finally:
+        os.environ.pop("DMOSOPT_FAULT_PLAN", None)
+    if g_snap["tenant_counts"].get("degraded", 0) != 2:
+        problems.append(
+            f"scheduler: expected 2 degraded tenants, got "
+            f"{g_snap['tenant_counts']}"
+        )
+    for bad in ("t1", "t2"):
+        if g_handles[bad].error is None or not g_handles[bad].done:
+            problems.append(
+                f"scheduler: {bad} should have been retired with a cause"
+            )
+    for k in ("t0", "s0", "s1"):
+        survivor, reference = g_fronts[k], ref_fronts[k]
+        if [e for e, _, _ in survivor] != [e for e, _, _ in reference]:
+            problems.append(
+                f"scheduler: {k} epoch sequence diverged under faults"
+            )
+            continue
+        for (e, xb, yb), (_, xs, ys) in zip(survivor, reference):
+            if not (np.array_equal(xb, xs) and np.array_equal(yb, ys)):
+                problems.append(
+                    f"scheduler: {k} epoch {e}: front NOT bitwise-equal "
+                    f"to the fault-free run"
+                )
+                break
+    nodes = g_snap.get("scheduler", {}).get("last_graph", {}).get("nodes", [])
+    if not nodes:
+        problems.append("scheduler: no task graph recorded in introspect()")
+    if any(n["state"] not in ("done", "skipped") for n in nodes):
+        problems.append(
+            f"scheduler: unexpected node states "
+            f"{[(n['name'], n['state']) for n in nodes]}"
+        )
+
     if problems:
         print("CHAOS SMOKE FAILED:")
         for p in problems:
             print(f"  - {p}")
         return 1
     print(
-        f"chaos smoke OK: survivors bitwise-invariant, "
-        f"t1/t2 degraded+retired "
+        f"chaos smoke OK: survivors bitwise-invariant (lockstep AND "
+        f"task-graph scheduler), t1/t2 degraded+retired "
         f"({counters['t1_failures']:.0f}/{counters['t2_failures']:.0f} "
         f"failures), {counters['nan_quarantined']:.0f} rows quarantined"
     )

@@ -55,6 +55,26 @@ FROZEN = {
                   "the dtlz7 ulp/fusion trajectory bisection",
         "pinned_by": "tests/test_ops.py dense-vs-chunked agreement pins",
     },
+    # PR 19: the dense variation cores are the bitwise-frozen CPU
+    # fallback behind the Pallas TPU kernels — both routes consume the
+    # same precomputed uniforms and the jitted dense core is the parity
+    # oracle the Pallas route is pinned bitwise against.
+    "dmosopt_tpu.ops.variation._mutation_core": {
+        "sha256": "d16f255c25939032f98c3a437f4a002fa84fc3c00989732c2f1922e57782c90f",
+        "reason": "polynomial-mutation dense core; the Pallas route is "
+                  "bitwise-pinned against its jitted form and every CPU "
+                  "trajectory hash flows through it",
+        "pinned_by": "tests/test_ops.py::"
+                     "test_variation_pallas_route_matches_dense",
+    },
+    "dmosopt_tpu.ops.variation._sbx_core": {
+        "sha256": "f57e59c76ecaac42545f5d7db0d235b63cdae18c1fe0cd231fb8a7294ea5ef96",
+        "reason": "SBX dense core; the Pallas route is bitwise-pinned "
+                  "against its jitted form and every CPU trajectory "
+                  "hash flows through it",
+        "pinned_by": "tests/test_ops.py::"
+                     "test_variation_pallas_route_matches_dense",
+    },
     # PR 3: the dense pairwise-distance kernel backs the single-chunk
     # regime of every crowding/survival distance consumer.
     "dmosopt_tpu.ops.distances._pairwise_distances_dense": {
